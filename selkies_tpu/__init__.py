@@ -1,0 +1,21 @@
+"""selkies_tpu — a TPU-native remote-desktop streaming framework.
+
+A brand-new framework with the capabilities of Selkies (skipperro/selkies-gstreamer):
+low-latency X11 → HTML5 browser streaming, where the video-encode path is a
+jit-compiled JAX/Pallas pipeline on TPU ("tpuenc") instead of NVENC/VA-API/x264.
+
+Package layout:
+  settings   — declarative flag/config system (reference: src/selkies/settings.py)
+  protocol   — byte-exact wire protocol codec (reference: selkies-core.js:2720-2990)
+  ops        — TPU compute primitives: color convert, blocked DCT, quantization
+  encoder    — tpuenc: the jit encode pipelines (JPEG-stripe, H.264-class)
+  models     — learned neural codec (flax) — flagship trainable model
+  parallel   — device meshes, shardings, multi-session batching over ICI
+  capture    — frame sources: synthetic (deterministic tests) and X11/XShm
+  server     — asyncio WebSocket data/control server, backpressure, displays
+  inputs     — keyboard/mouse/clipboard/gamepad injection plane
+  audio      — Opus encode (ctypes libopus) and audio pipelines
+  native     — C++ runtime components (entropy coder, ...) + build glue
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+from .wire import (  # noqa: F401
+    BinaryType,
+    VideoStripe,
+    FullFrame,
+    AudioChunk,
+    pack_jpeg_stripe,
+    pack_h264_stripe,
+    pack_full_frame,
+    pack_audio_chunk,
+    unpack_binary,
+    FrameId,
+    TextMessage,
+    parse_text_message,
+)

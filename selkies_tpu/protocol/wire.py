@@ -1,0 +1,289 @@
+"""Byte-exact wire protocol codec for the streaming data channel.
+
+This module is the compatibility contract with the Selkies web client: the
+binary layouts here are exactly what ``selkies-core.js`` demuxes in its
+``websocket.onmessage`` switch (reference ``addons/gst-web-core/selkies-core.js``
+lines 2753-2990) and the text verbs are what both sides exchange around it.
+Keeping these byte-identical lets the reference client be used as an oracle
+against this server.
+
+Binary frames, server → client (first byte = type):
+
+  0x00  full-frame H.264   [0x00][flags: 1=key][frame_id u16be][annexb...]
+  0x01  audio              [0x01][0x00][opus packet...]
+  0x03  JPEG stripe        [0x03][0x00][frame_id u16be][y_start u16be][jfif...]
+  0x04  H.264 stripe       [0x04][flags: 1=key][frame_id u16be][y_start u16be]
+                           [width u16be][height u16be][annexb...]
+
+Binary frames, client → server:
+
+  0x01  file upload chunk  [0x01][file bytes...]
+  0x02  microphone PCM     [0x02][s16le PCM...]
+
+Frame IDs are unsigned 16-bit with wraparound; see :class:`FrameId`.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass
+from typing import Optional, Tuple, Union
+
+
+class BinaryType(enum.IntEnum):
+    H264_FULL_FRAME = 0x00
+    AUDIO_OPUS = 0x01
+    MIC_PCM = 0x02  # client → server
+    JPEG_STRIPE = 0x03
+    H264_STRIPE = 0x04
+    FILE_CHUNK = 0x01  # client → server (same byte as audio; direction disambiguates)
+
+
+_U16 = struct.Struct(">H")
+
+
+# --------------------------------------------------------------------------
+# Frame-id arithmetic (u16 wraparound)
+
+
+class FrameId:
+    """Unsigned-16-bit frame-id arithmetic with wraparound.
+
+    The backpressure protocol computes ``sent - acked`` desync in modular
+    arithmetic (reference selkies.py:1203-1214); a desync above
+    ``WINDOW`` is treated as an anomalous wrap and reset.
+    """
+
+    MOD = 1 << 16
+    WINDOW = 1 << 15
+
+    @staticmethod
+    def next(fid: int) -> int:
+        return (fid + 1) % FrameId.MOD
+
+    @staticmethod
+    def desync(sent: int, acked: int) -> int:
+        """How far `acked` lags `sent`, modulo 2**16; negative is clamped to
+        the modular interpretation."""
+        return (sent - acked) % FrameId.MOD
+
+    @staticmethod
+    def is_anomalous(sent: int, acked: int) -> bool:
+        return FrameId.desync(sent, acked) >= FrameId.WINDOW
+
+
+# --------------------------------------------------------------------------
+# Typed frames
+
+
+@dataclass(frozen=True)
+class VideoStripe:
+    frame_id: int
+    y_start: int
+    payload: bytes
+    is_key: bool = True
+    width: int = 0   # H.264 stripes only
+    height: int = 0  # H.264 stripes only
+
+
+@dataclass(frozen=True)
+class FullFrame:
+    frame_id: int
+    payload: bytes
+    is_key: bool
+
+
+@dataclass(frozen=True)
+class AudioChunk:
+    payload: bytes
+
+
+# --------------------------------------------------------------------------
+# Packers
+
+
+def pack_jpeg_stripe(frame_id: int, y_start: int, jpeg: bytes) -> bytes:
+    """[0x03][0x00][frame_id][y_start][jfif] — client reads frame_id at
+    offset 2 and y_start at offset 4 (selkies-core.js:2908-2915)."""
+    return (
+        bytes((BinaryType.JPEG_STRIPE, 0))
+        + _U16.pack(frame_id & 0xFFFF)
+        + _U16.pack(y_start & 0xFFFF)
+        + jpeg
+    )
+
+
+def pack_h264_stripe(
+    frame_id: int, y_start: int, width: int, height: int, annexb: bytes,
+    is_key: bool,
+) -> bytes:
+    """10-byte header demuxed at selkies-core.js:2925-2945."""
+    return (
+        bytes((BinaryType.H264_STRIPE, 0x01 if is_key else 0x00))
+        + _U16.pack(frame_id & 0xFFFF)
+        + _U16.pack(y_start & 0xFFFF)
+        + _U16.pack(width & 0xFFFF)
+        + _U16.pack(height & 0xFFFF)
+        + annexb
+    )
+
+
+def pack_full_frame(frame_id: int, annexb: bytes, is_key: bool) -> bytes:
+    """[0x00][flags][frame_id][payload] (selkies-core.js:2814-2822)."""
+    return (
+        bytes((BinaryType.H264_FULL_FRAME, 0x01 if is_key else 0x00))
+        + _U16.pack(frame_id & 0xFFFF)
+        + annexb
+    )
+
+
+def pack_audio_chunk(opus: bytes) -> bytes:
+    """[0x01][0x00][opus] (selkies-core.js:2874-2880, server selkies.py:976)."""
+    return bytes((BinaryType.AUDIO_OPUS, 0)) + opus
+
+
+def pack_mic_chunk(pcm_s16le: bytes) -> bytes:
+    return bytes((BinaryType.MIC_PCM,)) + pcm_s16le
+
+
+# --------------------------------------------------------------------------
+# Unpacker (used by tests and by any Python client / conformance harness)
+
+
+def unpack_binary(
+    data: bytes,
+) -> Union[VideoStripe, FullFrame, AudioChunk, Tuple[BinaryType, bytes]]:
+    if not data:
+        raise ValueError("empty binary frame")
+    t = data[0]
+    if t == BinaryType.H264_FULL_FRAME:
+        if len(data) < 4:
+            raise ValueError("short 0x00 frame")
+        return FullFrame(
+            frame_id=_U16.unpack_from(data, 2)[0],
+            payload=bytes(data[4:]),
+            is_key=data[1] == 1,
+        )
+    if t == BinaryType.AUDIO_OPUS:
+        if len(data) < 2:
+            raise ValueError("short 0x01 frame")
+        return AudioChunk(payload=bytes(data[2:]))
+    if t == BinaryType.JPEG_STRIPE:
+        if len(data) < 6:
+            raise ValueError("short 0x03 frame")
+        return VideoStripe(
+            frame_id=_U16.unpack_from(data, 2)[0],
+            y_start=_U16.unpack_from(data, 4)[0],
+            payload=bytes(data[6:]),
+            is_key=True,
+        )
+    if t == BinaryType.H264_STRIPE:
+        if len(data) < 10:
+            raise ValueError("short 0x04 frame")
+        return VideoStripe(
+            frame_id=_U16.unpack_from(data, 2)[0],
+            y_start=_U16.unpack_from(data, 4)[0],
+            width=_U16.unpack_from(data, 6)[0],
+            height=_U16.unpack_from(data, 8)[0],
+            payload=bytes(data[10:]),
+            is_key=data[1] == 0x01,
+        )
+    return (BinaryType(t) if t in BinaryType._value2member_map_ else t, bytes(data[1:]))
+
+
+# --------------------------------------------------------------------------
+# Text-message grammar
+#
+# Client → server verbs (reference ws_handler dispatch, selkies.py:1843-2300,
+# and client sends in selkies-core.js / lib/input.js):
+#
+#   SETTINGS,{json}            settings negotiation
+#   CLIENT_FRAME_ACK <id>      backpressure ack
+#   r,<W>x<H>,<display_id>     resize request
+#   s,<scale>                  scale request
+#   cmd,<command>              command execution
+#   SET_NATIVE_CURSOR_RENDERING,<0|1>
+#   START_VIDEO / STOP_VIDEO / START_AUDIO / STOP_AUDIO
+#   FILE_UPLOAD_START:<path>:<size> / FILE_UPLOAD_END:<path> /
+#   FILE_UPLOAD_ERROR:<path>:<msg>
+#   cr                         clipboard read request
+#   cw,<b64> | cb,<mime>,<b64> clipboard write (text | binary)
+#   cws,<size> cwd,<b64> cwe   chunked text clipboard
+#   cbs,<mime>,<size> cbd,<b64> cbe  chunked binary clipboard
+#   kd,<keysym> ku,<keysym>    key down/up
+#   kr                         keyboard reset (all keys up)
+#   m,... m2,...               mouse (abs , rel)
+#   js c/b/a/d ...             gamepad connect/button/axis/disconnect
+#   _f <fps> / _l <latency>    client-reported metrics
+#
+# Server → client verbs:
+#
+#   MODE websockets
+#   {json} with "type": server_settings | system_stats | gpu_stats |
+#          network_stats | stream_resolution | display_config_update
+#   cursor,{json}
+#   clipboard,<b64> | clipboard_binary,<mime>,<b64>
+#   clipboard_start,<mime>,<size> clipboard_data,<b64> clipboard_finish
+#   PIPELINE_RESETTING <display_id>
+#   KILL <reason>
+#   VIDEO_STARTED / VIDEO_STOPPED / AUDIO_STARTED / AUDIO_STOPPED
+#   system_stats etc. as JSON
+
+
+@dataclass(frozen=True)
+class TextMessage:
+    """A parsed client→server text message."""
+
+    verb: str
+    args: Tuple[str, ...] = ()
+    json_body: Optional[str] = None
+
+
+_SIMPLE_VERBS = frozenset(
+    {
+        "START_VIDEO", "STOP_VIDEO", "START_AUDIO", "STOP_AUDIO",
+        "cr", "cwe", "cbe", "kr",
+    }
+)
+
+_COLON_VERBS = ("FILE_UPLOAD_START", "FILE_UPLOAD_END", "FILE_UPLOAD_ERROR")
+
+
+def parse_text_message(message: str) -> TextMessage:
+    """Parse a client text message into (verb, args).
+
+    The grammar is positional and comma/space/colon-delimited depending on the
+    verb family; this mirrors how the reference server branches on prefixes
+    (selkies.py:1843-2300) but centralizes it in one typed parser.
+    """
+    if message in _SIMPLE_VERBS:
+        return TextMessage(message)
+    if message.startswith("SETTINGS,"):
+        return TextMessage("SETTINGS", json_body=message[len("SETTINGS,"):])
+    if message.startswith("CLIENT_FRAME_ACK"):
+        parts = message.split()
+        return TextMessage("CLIENT_FRAME_ACK", tuple(parts[1:2]))
+    for verb in _COLON_VERBS:
+        if message.startswith(verb + ":"):
+            rest = message[len(verb) + 1:]
+            if verb == "FILE_UPLOAD_START":
+                path, _, size = rest.rpartition(":")
+                return TextMessage(verb, (path, size))
+            if verb == "FILE_UPLOAD_ERROR":
+                path, _, msg = rest.partition(":")
+                return TextMessage(verb, (path, msg))
+            return TextMessage(verb, (rest,))
+    if message.startswith("PIPELINE_RESETTING") or message.startswith("KILL"):
+        parts = message.split(None, 1)
+        return TextMessage(parts[0], tuple(parts[1:]))
+    if message.startswith("js "):
+        # gamepad: "js c/b/a/d,..." — keep the subverb with its args
+        return TextMessage("js", tuple(message[3:].split(",")))
+    if message.startswith("_f ") or message.startswith("_l "):
+        verb, _, val = message.partition(" ")
+        return TextMessage(verb, (val,))
+    if "," in message:
+        verb, _, rest = message.partition(",")
+        return TextMessage(verb, tuple(rest.split(",")) if rest else ())
+    return TextMessage(message)

@@ -1,0 +1,15 @@
+"""Test configuration: force the JAX CPU backend with 8 virtual devices.
+
+All tests run on CPU (the real chip is reserved for bench.py); multi-chip
+sharding tests use the 8 virtual devices as a simulated mesh, per the test
+strategy in SURVEY.md §4.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

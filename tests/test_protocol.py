@@ -1,0 +1,93 @@
+import pytest
+
+from selkies_tpu.protocol import (
+    AudioChunk,
+    FrameId,
+    FullFrame,
+    VideoStripe,
+    pack_audio_chunk,
+    pack_full_frame,
+    pack_h264_stripe,
+    pack_jpeg_stripe,
+    parse_text_message,
+    unpack_binary,
+)
+
+
+def test_jpeg_stripe_layout():
+    b = pack_jpeg_stripe(frame_id=300, y_start=128, jpeg=b"\xff\xd8data")
+    # exact byte layout the client demuxes: selkies-core.js:2908-2915
+    assert b[0] == 0x03 and b[1] == 0x00
+    assert int.from_bytes(b[2:4], "big") == 300
+    assert int.from_bytes(b[4:6], "big") == 128
+    assert b[6:] == b"\xff\xd8data"
+    f = unpack_binary(b)
+    assert isinstance(f, VideoStripe)
+    assert (f.frame_id, f.y_start, f.payload) == (300, 128, b"\xff\xd8data")
+
+
+def test_h264_stripe_layout():
+    b = pack_h264_stripe(5, 256, 1920, 64, b"\x00\x00\x01NAL", is_key=True)
+    assert b[0] == 0x04 and b[1] == 0x01
+    assert int.from_bytes(b[2:4], "big") == 5
+    assert int.from_bytes(b[4:6], "big") == 256
+    assert int.from_bytes(b[6:8], "big") == 1920
+    assert int.from_bytes(b[8:10], "big") == 64
+    f = unpack_binary(b)
+    assert isinstance(f, VideoStripe)
+    assert f.is_key and f.width == 1920 and f.height == 64
+
+
+def test_full_frame_and_audio():
+    b = pack_full_frame(65535, b"nal", is_key=False)
+    f = unpack_binary(b)
+    assert isinstance(f, FullFrame)
+    assert f.frame_id == 65535 and not f.is_key and f.payload == b"nal"
+
+    a = unpack_binary(pack_audio_chunk(b"opus"))
+    assert isinstance(a, AudioChunk) and a.payload == b"opus"
+
+
+def test_frame_id_wraparound():
+    assert FrameId.next(65535) == 0
+    assert FrameId.desync(3, 65533) == 6  # wrapped sender
+    assert not FrameId.is_anomalous(3, 65533)
+    assert FrameId.is_anomalous(0, 1)  # acked "ahead" of sent
+
+
+def test_short_frames_rejected():
+    with pytest.raises(ValueError):
+        unpack_binary(b"\x03\x00\x00")
+    with pytest.raises(ValueError):
+        unpack_binary(b"")
+
+
+@pytest.mark.parametrize(
+    "raw,verb,args",
+    [
+        ("CLIENT_FRAME_ACK 42", "CLIENT_FRAME_ACK", ("42",)),
+        ("r,1920x1080,primary", "r", ("1920x1080", "primary")),
+        ("START_VIDEO", "START_VIDEO", ()),
+        ("SET_NATIVE_CURSOR_RENDERING,1", "SET_NATIVE_CURSOR_RENDERING", ("1",)),
+        ("kd,65", "kd", ("65",)),
+        ("FILE_UPLOAD_END:a/b.txt", "FILE_UPLOAD_END", ("a/b.txt",)),
+        ("cmd,xdg-open .", "cmd", ("xdg-open .",)),
+        ("_f 60", "_f", ("60",)),
+        ("cr", "cr", ()),
+    ],
+)
+def test_text_grammar(raw, verb, args):
+    m = parse_text_message(raw)
+    assert m.verb == verb and m.args == args
+
+
+def test_settings_json_body():
+    m = parse_text_message('SETTINGS,{"encoder": "jpeg"}')
+    assert m.verb == "SETTINGS"
+    assert m.json_body == '{"encoder": "jpeg"}'
+
+
+def test_file_upload_start_path_with_colons():
+    m = parse_text_message("FILE_UPLOAD_START:dir/with:colon.txt:123")
+    assert m.verb == "FILE_UPLOAD_START"
+    assert m.args == ("dir/with:colon.txt", "123")

@@ -1,0 +1,81 @@
+from selkies_tpu.settings import (
+    BoolValue,
+    RangeValue,
+    SETTING_DEFINITIONS,
+    Settings,
+)
+
+
+def mk(argv=(), env=None):
+    return Settings(argv=list(argv), env=env or {})
+
+
+def test_defaults():
+    s = mk()
+    assert s.port == 8082
+    assert s.encoder == "jpeg"
+    assert s.framerate == RangeValue(8, 120, 60)
+    assert s.audio_enabled.value is True
+    assert s.file_transfers == ("upload", "download")
+
+
+def test_precedence_cli_over_env():
+    s = mk(argv=["--port", "9000"], env={"SELKIES_PORT": "7000"})
+    assert s.port == 9000
+
+
+def test_env_over_legacy_env():
+    s = mk(env={"SELKIES_PORT": "7000", "CUSTOM_WS_PORT": "6000"})
+    assert s.port == 7000
+    s2 = mk(env={"CUSTOM_WS_PORT": "6000"})
+    assert s2.port == 6000
+
+
+def test_bool_locked_suffix():
+    s = mk(env={"SELKIES_USE_CPU": "true|locked"})
+    assert s.use_cpu == BoolValue(True, locked=True)
+
+
+def test_range_single_value_locks():
+    s = mk(env={"SELKIES_FRAMERATE": "60"})
+    assert s.framerate.locked
+    assert s.framerate.clamp(200) == 60
+
+
+def test_range_parse_and_clamp():
+    s = mk(env={"SELKIES_JPEG_QUALITY": "10-80"})
+    q = s.jpeg_quality
+    assert (q.lo, q.hi) == (10, 80)
+    assert q.clamp(100) == 80
+    assert q.clamp(1) == 10
+
+
+def test_list_none_disables():
+    s = mk(env={"SELKIES_FILE_TRANSFERS": "none"})
+    assert s.file_transfers == ()
+
+
+def test_schema_payload_shape():
+    payload = mk().schema_payload()
+    assert payload["type"] == "server_settings"
+    st = payload["settings"]
+    # server-only settings excluded, like the reference handshake
+    assert "port" not in st and "debug" not in st
+    assert st["audio_enabled"] == {"value": True, "locked": False}
+    fr = st["framerate"]
+    assert (fr["min"], fr["max"], fr["default"]) == (8, 120, 60)
+    assert "allowed" in st["encoder"]
+
+
+def test_clamp_client_value():
+    s = mk(env={"SELKIES_USE_CPU": "false|locked"})
+    assert s.clamp_client_value("use_cpu", True) is False
+    assert s.clamp_client_value("jpeg_quality", 500) == 100
+    assert s.clamp_client_value("encoder", "nvh264enc") == "jpeg"
+    assert s.clamp_client_value("encoder", "x264enc-striped") == "x264enc-striped"
+
+
+def test_every_spec_has_help_and_unique_name():
+    names = [sp.name for sp in SETTING_DEFINITIONS]
+    assert len(names) == len(set(names))
+    assert all(sp.help for sp in SETTING_DEFINITIONS)
