@@ -1,0 +1,21 @@
+"""Console entry point: ``selkies-tpu`` (reference: selkies.py:3297 ws_entrypoint)."""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    from .settings import get_settings
+
+    settings = get_settings(sys.argv[1:])
+    try:
+        from .server.main import run
+    except ImportError as e:  # server not built yet in this tree
+        print(f"selkies-tpu: server unavailable ({e})", file=sys.stderr)
+        return 1
+    return run(settings)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,253 @@
+"""tpuenc JPEG-stripe profile.
+
+The frame is split into horizontal stripes (the reference's unit of spatial
+parallelism and of client-side decode — SURVEY.md §2.7); one jit-compiled
+device dispatch per frame produces quantized, zigzagged DCT coefficients for
+every stripe plus a per-stripe damage measure, and the host entropy-codes and
+ships only the stripes that changed ("damage gating", the TPU answer to the
+reference's XDamage-driven skip: always dispatch dense work on device, mask on
+host — SURVEY.md §7 hard part 4).
+
+Paint-over: after ``paint_over_trigger_frames`` consecutive static frames a
+stripe is re-emitted once at the high paint-over quality (same behavior as
+pixelflux's quality escalation, consumed via CaptureSettings at
+reference selkies.py:2919-2963).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.color import rgb_to_ycbcr, subsample_420
+from ..ops.dct import block_dct2, blockify
+from ..ops.quant import ZIGZAG, quality_scaled_tables
+from . import entropy_py
+from .jfif import EOI, jfif_headers
+from ..native import entropy_lib
+from .jpeg_tables import std_tables
+
+
+@dataclass(frozen=True)
+class StripeOutput:
+    """One encoded stripe ready for protocol packing."""
+
+    y_start: int
+    height: int
+    jpeg: bytes
+    is_paintover: bool
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("stripe_h",),
+    donate_argnames=("prev",),
+)
+def _device_encode(frame, prev, qy, qc, qsel, *, stripe_h: int):
+    """One whole-frame encode dispatch.
+
+    Args:
+      frame: [H, W, 3] uint8 RGB (H multiple of stripe_h, W multiple of 16).
+      prev:  [H, W, 3] uint8 previous frame (for damage detection); donated.
+      qy/qc: [nq, 8, 8] float32 quant tables (normal, paint-over, ...).
+      qsel:  [S] int32 per-stripe table index.
+    Returns:
+      yq  [H/8,  W/8,  64] int16 zigzag coefficients,
+      cbq [H/16, W/16, 64] int16,
+      crq [H/16, W/16, 64] int16,
+      damage [S] int32 max abs pixel delta per stripe,
+      frame (to become the caller's new ``prev`` without a host round-trip).
+    """
+    h, w, _ = frame.shape
+    s = h // stripe_h
+
+    diff = jnp.abs(frame.astype(jnp.int16) - prev.astype(jnp.int16))
+    damage = diff.reshape(s, stripe_h * w * 3).max(axis=1).astype(jnp.int32)
+
+    y, cb, cr = rgb_to_ycbcr(frame)
+    cb = subsample_420(cb)
+    cr = subsample_420(cr)
+
+    zz = jnp.asarray(ZIGZAG)
+
+    def component(plane, tables, rows_per_stripe):
+        blocks = blockify(plane) - 128.0            # [by, bx, 8, 8]
+        coeffs = block_dct2(blocks)
+        by = blocks.shape[0]
+        row_stripe = jnp.arange(by) // rows_per_stripe
+        recip = 1.0 / tables                        # [nq, 8, 8]
+        row_recip = recip[qsel[row_stripe]]         # [by, 8, 8]
+        q = jnp.round(coeffs * row_recip[:, None]).astype(jnp.int16)
+        return jnp.take(q.reshape(by, q.shape[1], 64), zz, axis=-1)
+
+    yq = component(y, qy, stripe_h // 8)
+    cbq = component(cb, qc, stripe_h // 16)
+    crq = component(cr, qc, stripe_h // 16)
+    return yq, cbq, crq, damage, frame
+
+
+def _entropy_encode_420(y: np.ndarray, cb: np.ndarray, cr: np.ndarray) -> bytes:
+    lib = entropy_lib()
+    if lib is None:
+        return entropy_py.encode_scan_420(y, cb, cr)
+    dc_l, ac_l, dc_c, ac_c = std_tables()
+    # worst case ~16 bits/coeff plus stuffing headroom
+    cap = (y.size + cb.size + cr.size) * 4 + 4096
+    out = np.empty(cap, dtype=np.uint8)
+    n = lib.jpeg_encode_scan_420(
+        np.ascontiguousarray(y), np.ascontiguousarray(cb),
+        np.ascontiguousarray(cr),
+        y.shape[0], y.shape[1],
+        dc_l.code_arr, dc_l.len_arr, ac_l.code_arr, ac_l.len_arr,
+        dc_c.code_arr, dc_c.len_arr, ac_c.code_arr, ac_c.len_arr,
+        out, cap,
+    )
+    if n < 0:
+        return entropy_py.encode_scan_420(y, cb, cr)
+    return out[:n].tobytes()
+
+
+class JpegStripeEncoder:
+    """Stateful per-display JPEG-stripe encoder (tpuenc v0).
+
+    Equivalent role to one pixelflux ``ScreenCapture`` encode context in the
+    reference; constructed per display by the capture manager.
+    """
+
+    def __init__(
+        self,
+        width: int,
+        height: int,
+        stripe_height: int = 64,
+        quality: int = 40,
+        paintover_quality: int = 90,
+        use_paint_over_quality: bool = True,
+        paint_over_trigger_frames: int = 15,
+        damage_threshold: int = 0,
+    ) -> None:
+        if stripe_height % 16:
+            raise ValueError("stripe_height must be a multiple of 16 (4:2:0 MCUs)")
+        self.width = width
+        self.height = height
+        # Padded geometry: width to 16 (MCU), height to a stripe multiple.
+        self.pad_w = -(-width // 16) * 16
+        self.pad_h = -(-height // stripe_height) * stripe_height
+        self.stripe_h = stripe_height
+        self.n_stripes = self.pad_h // stripe_height
+        self.damage_threshold = int(damage_threshold)
+        self.use_paint_over_quality = use_paint_over_quality
+        self.paint_over_trigger_frames = int(paint_over_trigger_frames)
+
+        self.set_quality(quality, paintover_quality)
+
+        self._prev = jnp.zeros((self.pad_h, self.pad_w, 3), dtype=jnp.uint8)
+        self._static_frames = np.zeros(self.n_stripes, dtype=np.int64)
+        self._painted = np.zeros(self.n_stripes, dtype=bool)
+        self._first_frame = True
+
+    # -- configuration -----------------------------------------------------
+
+    def set_quality(self, quality: int, paintover_quality: Optional[int] = None):
+        self.quality = int(quality)
+        if paintover_quality is not None:
+            self.paintover_quality = int(paintover_quality)
+        ly, lc = quality_scaled_tables(self.quality)
+        py, pc = quality_scaled_tables(self.paintover_quality)
+        self._qy_np = (ly, py)
+        self._qc_np = (lc, pc)
+        self._qy = jnp.stack([jnp.asarray(ly, jnp.float32), jnp.asarray(py, jnp.float32)])
+        self._qc = jnp.stack([jnp.asarray(lc, jnp.float32), jnp.asarray(pc, jnp.float32)])
+        self._headers: Dict[int, bytes] = {}
+
+    def _stripe_headers(self, qidx: int) -> bytes:
+        hdr = self._headers.get(qidx)
+        if hdr is None:
+            hdr = jfif_headers(
+                self.pad_w, self.stripe_h,
+                self._qy_np[qidx], self._qc_np[qidx], subsampling="420",
+            )
+            self._headers[qidx] = hdr
+        return hdr
+
+    # -- per-frame ---------------------------------------------------------
+
+    def _pad(self, frame: np.ndarray) -> np.ndarray:
+        if frame.shape[0] == self.pad_h and frame.shape[1] == self.pad_w:
+            return frame
+        return np.pad(
+            frame,
+            ((0, self.pad_h - frame.shape[0]), (0, self.pad_w - frame.shape[1]), (0, 0)),
+            mode="edge",
+        )
+
+    def encode_frame(self, frame: np.ndarray) -> List[StripeOutput]:
+        """Encode one [H, W, 3] uint8 RGB frame; returns changed stripes only."""
+        frame = self._pad(np.asarray(frame, dtype=np.uint8))
+
+        # Paint-over candidacy is decided from *previous* frames' history so
+        # the table index can ride the same dispatch.
+        paint_candidate = (
+            self.use_paint_over_quality
+            & (self._static_frames >= self.paint_over_trigger_frames)
+            & ~self._painted
+        )
+        qsel = jnp.asarray(paint_candidate.astype(np.int32))
+
+        yq, cbq, crq, damage, new_prev = _device_encode(
+            jnp.asarray(frame), self._prev, self._qy, self._qc, qsel,
+            stripe_h=self.stripe_h,
+        )
+        self._prev = new_prev
+        yq, cbq, crq, damage = (np.asarray(a) for a in (yq, cbq, crq, damage))
+
+        damaged = damage > self.damage_threshold
+        if self._first_frame:
+            damaged[:] = True
+            self._first_frame = False
+
+        out: List[StripeOutput] = []
+        yrows = self.stripe_h // 8
+        crows = self.stripe_h // 16
+        for s in range(self.n_stripes):
+            emit = False
+            is_paint = False
+            if damaged[s]:
+                self._static_frames[s] = 0
+                self._painted[s] = False
+                emit = True
+                is_paint = bool(paint_candidate[s])  # quantized w/ HQ table
+            else:
+                self._static_frames[s] += 1
+                if paint_candidate[s]:
+                    emit = True
+                    is_paint = True
+                    self._painted[s] = True
+            if not emit:
+                continue
+            scan = _entropy_encode_420(
+                yq[s * yrows:(s + 1) * yrows],
+                cbq[s * crows:(s + 1) * crows],
+                crq[s * crows:(s + 1) * crows],
+            )
+            qidx = 1 if is_paint else 0
+            jpeg = self._stripe_headers(qidx) + scan + EOI
+            out.append(
+                StripeOutput(
+                    y_start=s * self.stripe_h,
+                    height=self.stripe_h,
+                    jpeg=jpeg,
+                    is_paintover=is_paint,
+                )
+            )
+        return out
+
+    def force_keyframe(self) -> None:
+        """Make the next frame emit every stripe (client (re)connect)."""
+        self._first_frame = True
+        self._static_frames[:] = 0
+        self._painted[:] = False

@@ -1,0 +1,72 @@
+"""Native (C++) runtime components, built lazily with the system toolchain.
+
+The build is a single ``g++ -O3 -shared`` invocation cached next to the
+sources; if no toolchain is available the callers fall back to the
+pure-Python implementations (slower but correct).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "entropy.cpp")
+_SO = os.path.join(_DIR, "_libselkies_entropy.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", _SO, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        logger.warning("native entropy coder build failed (%s); using Python fallback", e)
+        return False
+
+
+def entropy_lib() -> Optional[ctypes.CDLL]:
+    """The compiled entropy coder, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if stale and not _compile():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            logger.warning("native entropy coder load failed: %s", e)
+            return None
+        i16p = np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS")
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        sig = [
+            i16p, i16p, i16p, ctypes.c_int, ctypes.c_int,
+            u32p, u8p, u32p, u8p, u32p, u8p, u32p, u8p,
+            u8p, ctypes.c_int64,
+        ]
+        for name in ("jpeg_encode_scan_420", "jpeg_encode_scan_444"):
+            fn = getattr(lib, name)
+            fn.argtypes = sig
+            fn.restype = ctypes.c_int64
+        _lib = lib
+        return _lib

@@ -1,0 +1,8 @@
+from .color import rgb_to_ycbcr, subsample_420  # noqa: F401
+from .dct import dct8_matrix, block_dct2, block_idct2, blockify, unblockify  # noqa: F401
+from .quant import (  # noqa: F401
+    ZIGZAG,
+    base_quant_tables,
+    quality_scaled_tables,
+    quantize_blocks,
+)

@@ -1,0 +1,45 @@
+"""Color-space transforms on device.
+
+The reference's pixel pipeline does BGRX→YUV conversion inside pixelflux's
+C++ SIMD code before x264/libjpeg; here it is a fused device op: a single
+3x3 matmul + offset that XLA folds into the surrounding encode pipeline
+(one HBM pass).
+
+Coefficients are JFIF/BT.601 full-range, the convention both libjpeg-class
+JPEG decoders and the browser `ImageDecoder` assume.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Rows: Y, Cb, Cr; columns: R, G, B.
+_RGB2YCC = jnp.array(
+    [
+        [0.299, 0.587, 0.114],
+        [-0.168736, -0.331264, 0.5],
+        [0.5, -0.418688, -0.081312],
+    ],
+    dtype=jnp.float32,
+)
+_YCC_OFFSET = jnp.array([0.0, 128.0, 128.0], dtype=jnp.float32)
+
+
+def rgb_to_ycbcr(rgb):
+    """[..., H, W, 3] uint8/float RGB → (Y, Cb, Cr) float32 planes [..., H, W].
+
+    Values are in [0, 255]; no level shift here (the DCT stage subtracts 128).
+    """
+    x = rgb.astype(jnp.float32)
+    ycc = jnp.einsum(
+        "...hwc,oc->...hwo", x, _RGB2YCC, precision=jax.lax.Precision.HIGHEST
+    ) + _YCC_OFFSET
+    return ycc[..., 0], ycc[..., 1], ycc[..., 2]
+
+
+def subsample_420(plane):
+    """2x2 mean-pool chroma subsampling: [..., H, W] → [..., H/2, W/2]."""
+    h, w = plane.shape[-2], plane.shape[-1]
+    p = plane.reshape(*plane.shape[:-2], h // 2, 2, w // 2, 2)
+    return p.mean(axis=(-3, -1))

@@ -32,12 +32,20 @@ from typing import Optional, Tuple, Union
 
 
 class BinaryType(enum.IntEnum):
+    """Server → client binary frame types (first byte)."""
+
     H264_FULL_FRAME = 0x00
     AUDIO_OPUS = 0x01
-    MIC_PCM = 0x02  # client → server
     JPEG_STRIPE = 0x03
     H264_STRIPE = 0x04
-    FILE_CHUNK = 0x01  # client → server (same byte as audio; direction disambiguates)
+
+
+class ClientBinaryType(enum.IntEnum):
+    """Client → server binary frame types; 0x01 here is a FILE chunk with a
+    1-byte header (selkies-core.js:4030), not audio — direction matters."""
+
+    FILE_CHUNK = 0x01
+    MIC_PCM = 0x02
 
 
 _U16 = struct.Struct(">H")
@@ -99,6 +107,16 @@ class AudioChunk:
     payload: bytes
 
 
+@dataclass(frozen=True)
+class FileChunk:
+    payload: bytes
+
+
+@dataclass(frozen=True)
+class MicChunk:
+    payload: bytes
+
+
 # --------------------------------------------------------------------------
 # Packers
 
@@ -144,16 +162,35 @@ def pack_audio_chunk(opus: bytes) -> bytes:
 
 
 def pack_mic_chunk(pcm_s16le: bytes) -> bytes:
-    return bytes((BinaryType.MIC_PCM,)) + pcm_s16le
+    return bytes((ClientBinaryType.MIC_PCM,)) + pcm_s16le
+
+
+def pack_file_chunk(chunk: bytes) -> bytes:
+    return bytes((ClientBinaryType.FILE_CHUNK,)) + chunk
 
 
 # --------------------------------------------------------------------------
 # Unpacker (used by tests and by any Python client / conformance harness)
 
 
+def unpack_client_binary(data: bytes) -> Union[FileChunk, MicChunk]:
+    """Demux a client → server binary frame (1-byte header)."""
+    if not data:
+        raise ValueError("empty binary frame")
+    t = data[0]
+    if t == ClientBinaryType.FILE_CHUNK:
+        return FileChunk(payload=bytes(data[1:]))
+    if t == ClientBinaryType.MIC_PCM:
+        return MicChunk(payload=bytes(data[1:]))
+    raise ValueError(f"unknown client binary type 0x{t:02x}")
+
+
 def unpack_binary(
     data: bytes,
 ) -> Union[VideoStripe, FullFrame, AudioChunk, Tuple[BinaryType, bytes]]:
+    """Demux a server → client binary frame (for client→server frames use
+    :func:`unpack_client_binary` — type byte 0x01 means different things per
+    direction)."""
     if not data:
         raise ValueError("empty binary frame")
     t = data[0]
@@ -214,7 +251,7 @@ def unpack_binary(
 #   kd,<keysym> ku,<keysym>    key down/up
 #   kr                         keyboard reset (all keys up)
 #   m,... m2,...               mouse (abs , rel)
-#   js c/b/a/d ...             gamepad connect/button/axis/disconnect
+#   js,c/b/a/d,...             gamepad connect/button/axis/disconnect
 #   _f <fps> / _l <latency>    client-reported metrics
 #
 # Server → client verbs:
@@ -277,12 +314,12 @@ def parse_text_message(message: str) -> TextMessage:
     if message.startswith("PIPELINE_RESETTING") or message.startswith("KILL"):
         parts = message.split(None, 1)
         return TextMessage(parts[0], tuple(parts[1:]))
-    if message.startswith("js "):
-        # gamepad: "js c/b/a/d,..." — keep the subverb with its args
-        return TextMessage("js", tuple(message[3:].split(",")))
     if message.startswith("_f ") or message.startswith("_l "):
         verb, _, val = message.partition(" ")
         return TextMessage(verb, (val,))
+    if message.startswith("cmd,"):
+        # the whole remainder is one free-text command; commas are content
+        return TextMessage("cmd", (message[4:],))
     if "," in message:
         verb, _, rest = message.partition(",")
         return TextMessage(verb, tuple(rest.split(",")) if rest else ())

@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import os
 import re
+import sys
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -307,7 +308,9 @@ class Settings:
         for spec in SETTING_DEFINITIONS:
             parser.add_argument(spec.cli_flag, dest=spec.name, type=str,
                                 default=None, help=spec.help)
-        ns, _unknown = parser.parse_known_args(list(argv) if argv is not None else [])
+        if argv is None:
+            argv = sys.argv[1:]
+        ns, _unknown = parser.parse_known_args(list(argv))
 
         self._values: Dict[str, Any] = {}
         for spec in SETTING_DEFINITIONS:
@@ -352,8 +355,8 @@ class Settings:
             if isinstance(spec, BoolSpec):
                 entry = {"value": v.value, "locked": v.locked}
             elif isinstance(spec, RangeSpec):
-                entry = {"value": v, "min": v.lo, "max": v.hi, "default": v.default}
-                entry["value"] = v.default
+                entry = {"value": v.default, "min": v.lo, "max": v.hi,
+                         "default": v.default}
             elif isinstance(spec, (EnumSpec, ListSpec)):
                 entry = {"value": list(v) if isinstance(v, tuple) else v,
                          "allowed": list(spec.allowed)}
@@ -380,7 +383,9 @@ class Settings:
         if isinstance(spec, BoolSpec):
             if current.locked:
                 return current.value
-            return bool(value) if not isinstance(value, str) else value.lower() == "true"
+            if isinstance(value, str):
+                return value.strip().lower() in ("true", "1", "yes", "on")
+            return bool(value)
         if isinstance(spec, EnumSpec):
             return value if value in spec.allowed else (
                 current if isinstance(current, str) else spec.normalize_default())

@@ -1,0 +1,120 @@
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from selkies_tpu.encoder.jpeg import JpegStripeEncoder
+from selkies_tpu.encoder import entropy_py
+from selkies_tpu.native import entropy_lib
+from selkies_tpu.encoder.jpeg_tables import std_tables
+
+
+def smooth_frame(h, w, seed=0):
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    r = 128 + 100 * np.sin(xx / 97.0) * np.cos(yy / 53.0)
+    g = 128 + 100 * np.cos(xx / 71.0)
+    b = 128 + 100 * np.sin(yy / 89.0)
+    return np.clip(np.stack([r, g, b], axis=-1), 0, 255).astype(np.uint8)
+
+
+def psnr(a, b):
+    mse = np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2)
+    return 10 * np.log10(255.0**2 / mse)
+
+
+def decode_stripes(stripes, h, w):
+    """Composite decoded stripes onto a canvas like the client does."""
+    canvas = np.zeros((h, w, 3), dtype=np.uint8)
+    for s in stripes:
+        img = np.asarray(Image.open(io.BytesIO(s.jpeg)).convert("RGB"))
+        rows = min(img.shape[0], h - s.y_start)
+        canvas[s.y_start:s.y_start + rows, :, :] = img[:rows, :w]
+    return canvas
+
+
+def test_stripes_decode_and_psnr():
+    h, w = 128, 160
+    frame = smooth_frame(h, w)
+    enc = JpegStripeEncoder(w, h, stripe_height=64, quality=90)
+    stripes = enc.encode_frame(frame)
+    assert len(stripes) == 2
+    assert [s.y_start for s in stripes] == [0, 64]
+    for s in stripes:
+        assert s.jpeg.startswith(b"\xff\xd8") and s.jpeg.endswith(b"\xff\xd9")
+    rec = decode_stripes(stripes, h, w)
+    assert psnr(frame, rec) > 35.0
+
+
+def test_unpadded_dimensions():
+    # 1080 is not a multiple of 64; 150 not a multiple of 16
+    h, w = 100, 150
+    enc = JpegStripeEncoder(w, h, stripe_height=64, quality=85)
+    stripes = enc.encode_frame(smooth_frame(h, w))
+    assert len(stripes) == 2  # padded to 128 rows
+    rec = decode_stripes(stripes, h, w)
+    assert psnr(smooth_frame(h, w), rec) > 30.0
+
+
+def test_damage_gating_skips_static_stripes():
+    h, w = 128, 160
+    frame = smooth_frame(h, w)
+    enc = JpegStripeEncoder(w, h, stripe_height=64, quality=80,
+                            use_paint_over_quality=False)
+    assert len(enc.encode_frame(frame)) == 2
+    assert enc.encode_frame(frame) == []  # identical frame → nothing
+    frame2 = frame.copy()
+    frame2[70, 10] ^= 0xFF  # touch stripe 1 only
+    out = enc.encode_frame(frame2)
+    assert [s.y_start for s in out] == [64]
+
+
+def test_paintover_escalation():
+    h, w = 64, 64
+    frame = smooth_frame(h, w)
+    enc = JpegStripeEncoder(w, h, stripe_height=64, quality=40,
+                            paintover_quality=95, paint_over_trigger_frames=3)
+    first = enc.encode_frame(frame)
+    assert len(first) == 1 and not first[0].is_paintover
+    outs = [enc.encode_frame(frame) for _ in range(6)]
+    paint = [o for frame_out in outs for o in frame_out]
+    assert len(paint) == 1 and paint[0].is_paintover
+    # paint-over stripe is visibly better than the low-quality first pass
+    rec_low = decode_stripes(first, h, w)
+    rec_hi = decode_stripes(paint, h, w)
+    assert psnr(frame, rec_hi) > psnr(frame, rec_low) + 3
+
+
+def test_force_keyframe_reemits_everything():
+    h, w = 128, 64
+    frame = smooth_frame(h, w)
+    enc = JpegStripeEncoder(w, h, stripe_height=64, quality=70,
+                            use_paint_over_quality=False)
+    enc.encode_frame(frame)
+    assert enc.encode_frame(frame) == []
+    enc.force_keyframe()
+    assert len(enc.encode_frame(frame)) == 2
+
+
+def test_native_entropy_matches_python_oracle():
+    lib = entropy_lib()
+    if lib is None:
+        pytest.skip("no C++ toolchain")
+    rng = np.random.default_rng(7)
+    by, bx = 4, 6
+    # sparse, mixed-sign coefficients exercising runs, ZRL, and categories
+    y = (rng.integers(-40, 40, size=(by, bx, 64))
+         * (rng.random((by, bx, 64)) < 0.15)).astype(np.int16)
+    cb = (rng.integers(-20, 20, size=(by // 2, bx // 2, 64))
+          * (rng.random((by // 2, bx // 2, 64)) < 0.1)).astype(np.int16)
+    cr = np.zeros_like(cb)
+    dc_l, ac_l, dc_c, ac_c = std_tables()
+    cap = y.size * 4 + cb.size * 8 + 4096
+    out = np.empty(cap, dtype=np.uint8)
+    n = lib.jpeg_encode_scan_420(
+        y, cb, cr, by, bx,
+        dc_l.code_arr, dc_l.len_arr, ac_l.code_arr, ac_l.len_arr,
+        dc_c.code_arr, dc_c.len_arr, ac_c.code_arr, ac_c.len_arr,
+        out, cap)
+    assert n > 0
+    assert out[:n].tobytes() == entropy_py.encode_scan_420(y, cb, cr)

@@ -1,0 +1,89 @@
+import jax.numpy as jnp
+import numpy as np
+
+from selkies_tpu.ops import (
+    base_quant_tables,
+    block_dct2,
+    block_idct2,
+    blockify,
+    dct8_matrix,
+    quality_scaled_tables,
+    rgb_to_ycbcr,
+    subsample_420,
+    unblockify,
+)
+from selkies_tpu.ops.quant import ZIGZAG, quantize_blocks, zigzag_blocks
+
+
+def test_dct_matrix_orthonormal():
+    c = np.asarray(dct8_matrix())
+    np.testing.assert_allclose(c @ c.T, np.eye(8), atol=1e-6)
+
+
+def test_dct_roundtrip():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-128, 127, size=(4, 5, 8, 8)).astype(np.float32)
+    y = block_idct2(block_dct2(jnp.asarray(x)))
+    np.testing.assert_allclose(np.asarray(y), x, atol=1e-3)
+
+
+def test_dct_dc_term():
+    x = jnp.full((1, 8, 8), 100.0)
+    c = np.asarray(block_dct2(x))[0]
+    assert abs(c[0, 0] - 800.0) < 1e-3  # orthonormal: DC = 8 * mean
+    assert np.abs(c).sum() - abs(c[0, 0]) < 1e-3
+
+
+def test_blockify_roundtrip():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 255, size=(64, 128)).astype(np.float32)
+    b = blockify(jnp.asarray(x))
+    assert b.shape == (8, 16, 8, 8)
+    np.testing.assert_array_equal(np.asarray(unblockify(b)), x)
+    # block (0,1) is columns 8..16 of rows 0..8
+    np.testing.assert_array_equal(np.asarray(b[0, 1]), x[:8, 8:16])
+
+
+def test_rgb_to_ycbcr_known_values():
+    rgb = jnp.asarray(
+        np.array([[[255, 255, 255], [0, 0, 0], [255, 0, 0]]], dtype=np.uint8)[None]
+    )
+    y, cb, cr = rgb_to_ycbcr(rgb[0])
+    y, cb, cr = np.asarray(y), np.asarray(cb), np.asarray(cr)
+    assert abs(y[0, 0] - 255.0) < 0.1 and abs(cb[0, 0] - 128) < 0.6
+    assert abs(y[0, 1] - 0.0) < 0.1
+    assert abs(y[0, 2] - 76.2) < 0.5 and cr[0, 2] > 200
+
+
+def test_subsample_420():
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(4, 4))
+    s = np.asarray(subsample_420(x))
+    assert s.shape == (2, 2)
+    assert s[0, 0] == (0 + 1 + 4 + 5) / 4
+
+
+def test_quality_tables_monotone():
+    q10_l, _ = quality_scaled_tables(10)
+    q90_l, _ = quality_scaled_tables(90)
+    assert (q10_l.astype(int) >= q90_l.astype(int)).all()
+    q100_l, q100_c = quality_scaled_tables(100)
+    assert (q100_l == 1).all() and (q100_c == 1).all()
+    q50_l, _ = quality_scaled_tables(50)
+    base_l, _ = base_quant_tables()
+    np.testing.assert_array_equal(q50_l, base_l)
+
+
+def test_zigzag_is_permutation():
+    assert sorted(ZIGZAG.tolist()) == list(range(64))
+    # spec spot checks
+    assert ZIGZAG[0] == 0 and ZIGZAG[1] == 1 and ZIGZAG[2] == 8 and ZIGZAG[63] == 63
+
+
+def test_quantize_and_zigzag():
+    coeffs = jnp.asarray(np.full((2, 2, 8, 8), 50.0, dtype=np.float32))
+    table = jnp.asarray(np.full((8, 8), 25.0, dtype=np.float32))
+    q = quantize_blocks(coeffs, table)
+    assert q.dtype == jnp.int16
+    assert (np.asarray(q) == 2).all()
+    z = zigzag_blocks(q)
+    assert z.shape == (2, 2, 64)

@@ -91,3 +91,23 @@ def test_file_upload_start_path_with_colons():
     m = parse_text_message("FILE_UPLOAD_START:dir/with:colon.txt:123")
     assert m.verb == "FILE_UPLOAD_START"
     assert m.args == ("dir/with:colon.txt", "123")
+
+
+def test_client_binary_direction():
+    from selkies_tpu.protocol import (
+        FileChunk, MicChunk, pack_file_chunk, pack_mic_chunk, unpack_client_binary,
+    )
+    f = unpack_client_binary(pack_file_chunk(b"\x01\x02data"))
+    assert isinstance(f, FileChunk) and f.payload == b"\x01\x02data"
+    m = unpack_client_binary(pack_mic_chunk(b"pcm"))
+    assert isinstance(m, MicChunk) and m.payload == b"pcm"
+
+
+def test_cmd_with_commas_is_single_arg():
+    m = parse_text_message("cmd,ffmpeg -vf scale=1280:720,fps=30")
+    assert m.verb == "cmd" and m.args == ("ffmpeg -vf scale=1280:720,fps=30",)
+
+
+def test_gamepad_comma_form():
+    m = parse_text_message("js,c,0,Xbox,1118,654")
+    assert m.verb == "js" and m.args == ("c", "0", "Xbox", "1118", "654")

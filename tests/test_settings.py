@@ -79,3 +79,14 @@ def test_every_spec_has_help_and_unique_name():
     names = [sp.name for sp in SETTING_DEFINITIONS]
     assert len(names) == len(set(names))
     assert all(sp.help for sp in SETTING_DEFINITIONS)
+
+
+def test_clamp_bool_accepts_numeric_strings():
+    s = mk()
+    assert s.clamp_client_value("audio_enabled", "1") is True
+    assert s.clamp_client_value("audio_enabled", "0") is False
+
+
+def test_schema_range_value_is_json_safe():
+    import json
+    json.dumps(mk().schema_payload())
