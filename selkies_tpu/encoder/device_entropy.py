@@ -1,0 +1,350 @@
+"""Device-side (on-TPU) baseline-JPEG Huffman entropy coding.
+
+Why: pulling DCT coefficients to the host costs ~6 MB/frame of D2H traffic —
+the dominant cost on PCIe-attached chips at high session counts and fatal on
+tunneled devices. Entropy coding *on device* shrinks the per-frame transfer to
+the compressed bitstream itself (tens of KB). This is SURVEY.md §7 "hard part
+1" resolved in favor of option (a'): a data-parallel formulation of Huffman
+coding that fits XLA/TPU:
+
+  1. blocks are gathered into JPEG MCU scan order (static permutation);
+  2. DC deltas come from a static predecessor-index gather (the serial DC
+     chain is just a shifted subtraction in scan order);
+  3. zero-run lengths come from an inclusive ``cummax`` of nonzero positions
+     (the only "sequential" part of RLE, done as an associative scan);
+  4. every coefficient expands into ≤4 fixed symbol slots (3 ZRL + 1 value;
+     a run ≤62 needs ≤3 ZRLs), giving a dense [blocks, 254] symbol grid;
+  5. symbol bit offsets are a segmented cumulative sum (per stripe);
+  6. bit packing exploits that contributions to one 32-bit output word have
+     disjoint bits: word values are recovered from a plain (wrapping) cumsum
+     of per-symbol word contributions differenced at word boundaries found
+     by ``searchsorted`` — no scatter, no atomics;
+  7. stripes are padded with 1-bits to byte alignment (T.81 F.1.2.3) via one
+     synthetic trailing symbol per stripe, then compacted back-to-back at
+     word granularity so the host fetches one dense buffer.
+
+The output is bit-exact with the host coders (entropy_py / native); byte
+stuffing (0xFF→0xFF00) happens on host over the ~75 KB result.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .jpeg_tables import std_tables
+
+
+# --------------------------------------------------------------------------
+# Static geometry
+
+
+@functools.lru_cache(maxsize=32)
+def scan_geometry(pad_h: int, pad_w: int, stripe_h: int):
+    """Static scan-order arrays for a 4:2:0 frame geometry.
+
+    Returns (perm, is_chroma, dc_prev_idx, blocks_per_stripe):
+      perm[M]        — index into concat(Y, Cb, Cr) flattened block arrays,
+                       in MCU-interleaved stripe-major order;
+      is_chroma[M]   — Huffman table selector per block;
+      dc_prev_idx[M] — stream index of the DC predecessor (same component,
+                       same stripe) or -1 at each stripe/component start.
+    """
+    by, bx = pad_h // 8, pad_w // 8
+    cby, cbx = pad_h // 16, pad_w // 16
+    s_cnt = pad_h // stripe_h
+    yrows, crows = stripe_h // 8, stripe_h // 16
+    mcols = pad_w // 16
+
+    perm = []
+    is_chroma = []
+    dc_prev = []
+    last = {}
+    y_base, cb_base, cr_base = 0, by * bx, by * bx + cby * cbx
+    for s in range(s_cnt):
+        last.clear()  # DC prediction resets per stripe (independent JPEGs)
+        for mr in range(crows):
+            for mc in range(mcols):
+                for dy in (0, 1):
+                    for dx in (0, 1):
+                        perm.append(
+                            y_base + (s * yrows + 2 * mr + dy) * bx + (2 * mc + dx))
+                        is_chroma.append(0)
+                        i = len(perm) - 1
+                        dc_prev.append(last.get("y", -1))
+                        last["y"] = i
+                for base, key in ((cb_base, "cb"), (cr_base, "cr")):
+                    perm.append(base + (s * crows + mr) * cbx + mc)
+                    is_chroma.append(1)
+                    i = len(perm) - 1
+                    dc_prev.append(last.get(key, -1))
+                    last[key] = i
+    blocks_per_stripe = crows * mcols * 6
+    return (
+        np.asarray(perm, np.int32),
+        np.asarray(is_chroma, np.int32),
+        np.asarray(dc_prev, np.int32),
+        blocks_per_stripe,
+    )
+
+
+def _huff_arrays():
+    """Stacked [2, 256] (luma, chroma) code/length arrays for DC and AC."""
+    dc_l, ac_l, dc_c, ac_c = std_tables()
+    dc_code = np.stack([dc_l.code_arr, dc_c.code_arr]).astype(np.uint32)
+    dc_len = np.stack([dc_l.len_arr, dc_c.len_arr]).astype(np.int32)
+    ac_code = np.stack([ac_l.code_arr, ac_c.code_arr]).astype(np.uint32)
+    ac_len = np.stack([ac_l.len_arr, ac_c.len_arr]).astype(np.int32)
+    return dc_code, dc_len, ac_code, ac_len
+
+
+def _bitlen(a):
+    """Magnitude category of |a| (int32, |a| ≤ 2047): exact via f32 log2."""
+    af = jnp.abs(a).astype(jnp.float32)
+    return jnp.where(a == 0, 0, jnp.floor(jnp.log2(jnp.maximum(af, 1.0))) + 1
+                     ).astype(jnp.int32)
+
+
+def _vbits(v, size):
+    """Value bits: v for v>0 else ones'-complement (T.81 F.1.2.1)."""
+    raw = jnp.where(v > 0, v, v + (1 << size) - 1)
+    return (raw & ((1 << size) - 1)).astype(jnp.uint32)
+
+
+def _sorted_segment_words(word_idx, contrib, n_words):
+    """Sum contributions grouped by (sorted, non-decreasing) word index.
+
+    Within a word all contributions have disjoint bits, so their u32 sum is
+    exact; the wrapping cumsum across words cancels in the difference.
+    """
+    cs = jnp.cumsum(contrib.astype(jnp.uint32), dtype=jnp.uint32)
+    hi = jnp.searchsorted(word_idx, jnp.arange(n_words, dtype=word_idx.dtype),
+                          side="right")
+    s_at = jnp.where(hi > 0, cs[jnp.maximum(hi - 1, 0)], 0)
+    return s_at - jnp.concatenate([jnp.zeros((1,), jnp.uint32), s_at[:-1]])
+
+
+class DeviceEntropyPacker:
+    """Per-geometry compiled entropy pack: coefficients → packed bitstreams.
+
+    ``pack(yq, cbq, crq)`` returns:
+      words  [cap_words] uint32 — all stripes' scans compacted back-to-back
+             (each stripe starts word-aligned; bits are MSB-first, so bytes
+             come from big-endian u32 serialization);
+      nbytes [S] int32         — scan byte count per stripe (incl. padding);
+      base_words [S] int32     — word offset of each stripe in ``words``.
+    """
+
+    #: symbol slots per block: DC + 63 × (3 ZRL + value) + EOB
+    SLOTS = 254
+
+    def __init__(
+        self,
+        pad_h: int,
+        pad_w: int,
+        stripe_h: int,
+        max_stripe_bytes: int = 1 << 17,
+    ) -> None:
+        perm, is_chroma, dc_prev, bps = scan_geometry(pad_h, pad_w, stripe_h)
+        self.n_stripes = pad_h // stripe_h
+        self.blocks_per_stripe = bps
+        self.max_stripe_words = max_stripe_bytes // 4
+        # Sized for the worst case (every stripe at its cap), so compaction
+        # can never spill a stripe past the buffer — an overflowing stripe is
+        # clamped to max_stripe_words and flagged; later stripes stay intact.
+        self.cap_words = self.n_stripes * self.max_stripe_words
+        dc_code, dc_len, ac_code, ac_len = _huff_arrays()
+
+        n_stripes = self.n_stripes
+        max_w = self.max_stripe_words
+        cap_words = self.cap_words
+        slots = self.SLOTS
+        syms_per_stripe = bps * slots
+
+        def pack_fn(yq, cbq, crq):
+            allb = jnp.concatenate(
+                [yq.reshape(-1, 64), cbq.reshape(-1, 64), crq.reshape(-1, 64)]
+            ).astype(jnp.int32)
+            stream = allb[jnp.asarray(perm)]                    # [M, 64]
+            chroma = jnp.asarray(is_chroma)                     # [M]
+            m_blocks = stream.shape[0]
+
+            def lut(table_pair, sym):
+                """Per-block table select without materializing [M, 256]:
+                gather from each 256-entry constant, then pick by component."""
+                tl = jnp.take(jnp.asarray(table_pair[0]), sym)
+                tc = jnp.take(jnp.asarray(table_pair[1]), sym)
+                sel = chroma.reshape((-1,) + (1,) * (sym.ndim - 1)) == 1
+                return jnp.where(sel, tc, tl)
+
+            # ---- DC symbols ------------------------------------------------
+            dc = stream[:, 0]
+            prev_idx = jnp.asarray(dc_prev)
+            pred = jnp.where(prev_idx < 0, 0, dc[jnp.maximum(prev_idx, 0)])
+            diff = dc - pred
+            dsize = _bitlen(diff)
+            dcode = lut(dc_code, dsize)
+            dlen = lut(dc_len, dsize)
+            dc_bits = ((dcode << dsize.astype(jnp.uint32))
+                       | _vbits(diff, dsize)).astype(jnp.uint32)
+            dc_slen = dlen + dsize
+
+            # ---- AC run-lengths -------------------------------------------
+            z = stream[:, 1:]                                   # [M, 63]
+            nzm = z != 0
+            posk = jnp.arange(1, 64, dtype=jnp.int32)[None, :]
+            p = jnp.where(nzm, posk, 0)
+            m_incl = jax.lax.associative_scan(jnp.maximum, p, axis=1)
+            prev_excl = jnp.concatenate(
+                [jnp.zeros((m_blocks, 1), jnp.int32), m_incl[:, :-1]], axis=1)
+            run = posk - prev_excl - 1
+            size = _bitlen(z)
+            rem = run & 15
+            nzrl = run >> 4                                     # 0..3
+
+            ac_sym = ((rem << 4) | size)
+            acode = lut(ac_code, ac_sym)
+            alen = lut(ac_len, ac_sym)
+            main_bits = ((acode << size.astype(jnp.uint32))
+                         | _vbits(z, size)).astype(jnp.uint32)
+            main_len = jnp.where(nzm, alen + size, 0)
+
+            zrl_code = jnp.where(chroma == 1, int(ac_code[1][0xF0]),
+                                 int(ac_code[0][0xF0]))[:, None]
+            zrl_len = jnp.where(chroma == 1, int(ac_len[1][0xF0]),
+                                int(ac_len[0][0xF0]))[:, None]
+            zrl_slots_bits = jnp.broadcast_to(
+                zrl_code[..., None], (m_blocks, 63, 3)).astype(jnp.uint32)
+            zrl_active = nzm[..., None] & (
+                nzrl[..., None] > jnp.arange(3)[None, None, :])
+            zrl_slots_len = jnp.where(zrl_active, zrl_len[..., None], 0)
+
+            # ---- EOB -------------------------------------------------------
+            eob_active = m_incl[:, -1] != 63
+            eob_bits = jnp.where(chroma == 1, int(ac_code[1][0x00]),
+                                 int(ac_code[0][0x00])).astype(jnp.uint32)
+            eob_len = jnp.where(
+                eob_active,
+                jnp.where(chroma == 1, int(ac_len[1][0x00]), int(ac_len[0][0x00])),
+                0)
+
+            # ---- dense symbol grid [M, 254] -------------------------------
+            ac_slots_bits = jnp.concatenate(
+                [zrl_slots_bits, main_bits[..., None]], axis=2).reshape(m_blocks, 252)
+            ac_slots_len = jnp.concatenate(
+                [zrl_slots_len, main_len[..., None]], axis=2).reshape(m_blocks, 252)
+            bits_g = jnp.concatenate(
+                [dc_bits[:, None], ac_slots_bits, eob_bits[:, None]], axis=1)
+            lens_g = jnp.concatenate(
+                [dc_slen[:, None], ac_slots_len, eob_len[:, None]], axis=1)
+
+            flat_bits = bits_g.reshape(-1)
+            flat_len = lens_g.reshape(-1)
+
+            # ---- per-stripe bit offsets (segmented cumsum) ----------------
+            cum = jnp.cumsum(flat_len)
+            seg_last = cum.reshape(n_stripes, syms_per_stripe)[:, -1]
+            stripe_end = seg_last                            # inclusive cumsum @ seg end
+            stripe_base = jnp.concatenate(
+                [jnp.zeros((1,), cum.dtype), stripe_end[:-1]])
+            stripe_of = (
+                jnp.arange(flat_len.shape[0], dtype=jnp.int32) // syms_per_stripe)
+            off = cum - flat_len - stripe_base[stripe_of]    # bit offset in stripe
+            t_bits = stripe_end - stripe_base                # [S]
+
+            # ---- stripe byte-alignment padding ----------------------------
+            pad = (-t_bits) % 8
+            t_bytes = ((t_bits + pad) // 8).astype(jnp.int32)
+
+            # ---- word contributions ---------------------------------------
+            def contributions(offv, lenv, bitsv, stripev):
+                """Split each symbol into ≤2 word contributions (len ≤ 27 < 32)."""
+                word_in_stripe = jnp.minimum((offv >> 5), max_w - 1)
+                overflow = (offv + lenv) > (max_w * 32)
+                bitpos = (offv & 31).astype(jnp.int32)
+                shift = 32 - bitpos - lenv
+                safe = jnp.where((lenv > 0) & ~overflow, bitsv, 0)
+                c0 = jnp.where(
+                    shift >= 0,
+                    safe << jnp.maximum(shift, 0).astype(jnp.uint32),
+                    safe >> jnp.maximum(-shift, 0).astype(jnp.uint32),
+                ).astype(jnp.uint32)
+                c1 = jnp.where(
+                    shift >= 0, jnp.uint32(0),
+                    safe << jnp.maximum(32 + shift, 0).astype(jnp.uint32),
+                ).astype(jnp.uint32)
+                w0 = stripev * max_w + word_in_stripe
+                w1 = jnp.minimum(w0 + 1, n_stripes * max_w - 1)
+                return w0, c0, w1, c1
+
+            n_words = n_stripes * max_w
+            w0, c0, w1, c1 = contributions(off, flat_len, flat_bits, stripe_of)
+            # Both streams are sorted (symbols are stripe-major with monotone
+            # offsets), so word values fall out of a wrapping cumsum
+            # differenced at word boundaries — no scatter.
+            words = (
+                _sorted_segment_words(w0, c0, n_words)
+                + _sorted_segment_words(w1, c1, n_words)
+            )
+            # The S padding symbols (one per stripe) are added by a tiny
+            # scatter instead of re-sorting 12M symbols around them.
+            pw0, pc0, pw1, pc1 = contributions(
+                t_bits, pad, ((1 << pad) - 1).astype(jnp.uint32),
+                jnp.arange(n_stripes, dtype=jnp.int32))
+            words = words.at[pw0].add(pc0).at[pw1].add(pc1)
+
+            # ---- compaction ------------------------------------------------
+            # Per-stripe clamp: an overflowed stripe still occupies exactly
+            # max_w words so downstream stripes' offsets stay valid.
+            wc = jnp.minimum((t_bytes + 3) // 4, max_w)
+            base_words = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(wc)[:-1].astype(jnp.int32)])
+            j = jnp.arange(cap_words, dtype=jnp.int32)
+            sidx = jnp.clip(
+                jnp.searchsorted(base_words, j, side="right") - 1, 0, n_stripes - 1)
+            src = sidx * max_w + (j - base_words[sidx])
+            valid = j < (base_words[-1] + wc[-1])
+            src = jnp.clip(src, 0, n_words - 1)
+            compacted = jnp.where(valid, words[src], 0)
+
+            stripe_overflow = t_bytes > (max_w * 4)
+            return compacted, t_bytes, base_words, stripe_overflow
+
+        self._pack_fn = pack_fn
+        self._pack = jax.jit(pack_fn)
+
+    def pack(self, yq, cbq, crq):
+        return self._pack(yq, cbq, crq)
+
+    def bucket_words(self, total_words: int) -> int:
+        """Power-of-two fetch size for a packed-word count (bounds the number
+        of distinct slice executables compiled for D2H)."""
+        n = 1024
+        while n < total_words:
+            n <<= 1
+        return min(n, self.cap_words)
+
+
+def stuff_bytes(scan: bytes) -> bytes:
+    """JPEG byte stuffing (0xFF → 0xFF 0x00) over a scan, vectorized."""
+    arr = np.frombuffer(scan, dtype=np.uint8)
+    idx = np.flatnonzero(arr == 0xFF)
+    if idx.size == 0:
+        return scan
+    return np.insert(arr, idx + 1, 0).tobytes()
+
+
+def words_to_stripe_bytes(
+    words: np.ndarray, base_words: np.ndarray, nbytes: np.ndarray
+) -> Tuple[bytes, ...]:
+    """Split the compacted word buffer into per-stripe scan byte strings."""
+    be = words.astype(">u4").tobytes()
+    out = []
+    for s in range(len(nbytes)):
+        start = int(base_words[s]) * 4
+        out.append(be[start:start + int(nbytes[s])])
+    return tuple(out)

@@ -43,12 +43,7 @@ class StripeOutput:
     is_paintover: bool
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("stripe_h",),
-    donate_argnames=("prev",),
-)
-def _device_encode(frame, prev, qy, qc, qsel, *, stripe_h: int):
+def _encode_body(frame, prev, qy, qc, qsel, *, stripe_h: int):
     """One whole-frame encode dispatch.
 
     Args:
@@ -91,6 +86,13 @@ def _device_encode(frame, prev, qy, qc, qsel, *, stripe_h: int):
     return yq, cbq, crq, damage, frame
 
 
+_device_encode = functools.partial(
+    jax.jit,
+    static_argnames=("stripe_h",),
+    donate_argnames=("prev",),
+)(_encode_body)
+
+
 def _entropy_encode_420(y: np.ndarray, cb: np.ndarray, cr: np.ndarray) -> bytes:
     lib = entropy_lib()
     if lib is None:
@@ -117,6 +119,11 @@ class JpegStripeEncoder:
 
     Equivalent role to one pixelflux ``ScreenCapture`` encode context in the
     reference; constructed per display by the capture manager.
+
+    ``entropy="device"`` (default) runs Huffman coding on the TPU too
+    (:mod:`.device_entropy`), so per-frame D2H is just the compressed
+    bitstream; ``entropy="host"`` pulls coefficient planes back and codes
+    them with the native/Python coder (oracle and fallback path).
     """
 
     def __init__(
@@ -129,9 +136,12 @@ class JpegStripeEncoder:
         use_paint_over_quality: bool = True,
         paint_over_trigger_frames: int = 15,
         damage_threshold: int = 0,
+        entropy: str = "device",
     ) -> None:
         if stripe_height % 16:
             raise ValueError("stripe_height must be a multiple of 16 (4:2:0 MCUs)")
+        if entropy not in ("device", "host"):
+            raise ValueError(f"unknown entropy mode {entropy!r}")
         self.width = width
         self.height = height
         # Padded geometry: width to 16 (MCU), height to a stripe multiple.
@@ -142,6 +152,7 @@ class JpegStripeEncoder:
         self.damage_threshold = int(damage_threshold)
         self.use_paint_over_quality = use_paint_over_quality
         self.paint_over_trigger_frames = int(paint_over_trigger_frames)
+        self.entropy = entropy
 
         self.set_quality(quality, paintover_quality)
 
@@ -149,6 +160,22 @@ class JpegStripeEncoder:
         self._static_frames = np.zeros(self.n_stripes, dtype=np.int64)
         self._painted = np.zeros(self.n_stripes, dtype=bool)
         self._first_frame = True
+
+        if entropy == "device":
+            from .device_entropy import DeviceEntropyPacker
+
+            self._packer = DeviceEntropyPacker(self.pad_h, self.pad_w, self.stripe_h)
+            packer_fn = self._packer._pack_fn
+            stripe_h = self.stripe_h
+
+            @functools.partial(jax.jit, donate_argnames=("prev",))
+            def step(frame, prev, qy, qc, qsel):
+                yq, cbq, crq, damage, new_prev = _encode_body(
+                    frame, prev, qy, qc, qsel, stripe_h=stripe_h)
+                words, nbytes, base, ovf = packer_fn(yq, cbq, crq)
+                return words, nbytes, base, ovf, damage, new_prev, yq, cbq, crq
+
+            self._step = step
 
     # -- configuration -----------------------------------------------------
 
@@ -185,18 +212,91 @@ class JpegStripeEncoder:
             mode="edge",
         )
 
-    def encode_frame(self, frame: np.ndarray) -> List[StripeOutput]:
-        """Encode one [H, W, 3] uint8 RGB frame; returns changed stripes only."""
-        frame = self._pad(np.asarray(frame, dtype=np.uint8))
-
-        # Paint-over candidacy is decided from *previous* frames' history so
-        # the table index can ride the same dispatch.
-        paint_candidate = (
+    def _paint_candidates(self) -> np.ndarray:
+        """Paint-over candidacy from *previous* frames' history, so the quant
+        table index can ride the same dispatch as the frame."""
+        return (
             self.use_paint_over_quality
             & (self._static_frames >= self.paint_over_trigger_frames)
             & ~self._painted
         )
+
+    def _decide_emits(self, damaged: np.ndarray, paint_candidate: np.ndarray):
+        """Update damage history; return (emit, is_paint) flag arrays."""
+        if self._first_frame:
+            damaged = np.ones_like(damaged)
+            self._first_frame = False
+        emit = np.zeros(self.n_stripes, dtype=bool)
+        is_paint = np.zeros(self.n_stripes, dtype=bool)
+        for s in range(self.n_stripes):
+            if damaged[s]:
+                self._static_frames[s] = 0
+                self._painted[s] = False
+                emit[s] = True
+                is_paint[s] = bool(paint_candidate[s])  # quantized w/ HQ table
+            else:
+                self._static_frames[s] += 1
+                if paint_candidate[s]:
+                    emit[s] = True
+                    is_paint[s] = True
+                    self._painted[s] = True
+        return emit, is_paint
+
+    def _assemble(self, emit, is_paint, scans) -> List[StripeOutput]:
+        out: List[StripeOutput] = []
+        for s in range(self.n_stripes):
+            if not emit[s]:
+                continue
+            qidx = 1 if is_paint[s] else 0
+            out.append(
+                StripeOutput(
+                    y_start=s * self.stripe_h,
+                    height=self.stripe_h,
+                    jpeg=self._stripe_headers(qidx) + scans[s] + EOI,
+                    is_paintover=bool(is_paint[s]),
+                )
+            )
+        return out
+
+    def _fetch_bucket(self, words, total_words: int):
+        """Fetch a power-of-two slice of the packed word buffer (each distinct
+        slice shape compiles once; bucketing bounds the executable count)."""
+        return np.asarray(words[:self._packer.bucket_words(total_words)])
+
+    def encode_frame(self, frame: np.ndarray) -> List[StripeOutput]:
+        """Encode one [H, W, 3] uint8 RGB frame; returns changed stripes only."""
+        frame = self._pad(np.asarray(frame, dtype=np.uint8))
+        paint_candidate = self._paint_candidates()
         qsel = jnp.asarray(paint_candidate.astype(np.int32))
+        yrows = self.stripe_h // 8
+        crows = self.stripe_h // 16
+
+        if self.entropy == "device":
+            from .device_entropy import stuff_bytes, words_to_stripe_bytes
+
+            words, nbytes, base, ovf, damage, new_prev, yq, cbq, crq = self._step(
+                jnp.asarray(frame), self._prev, self._qy, self._qc, qsel)
+            self._prev = new_prev
+            nbytes_np, base_np, damage_np, ovf_np = (
+                np.asarray(a) for a in (nbytes, base, damage, ovf))
+            emit, is_paint = self._decide_emits(
+                damage_np > self.damage_threshold, paint_candidate)
+            scans: List[bytes] = [b""] * self.n_stripes
+            if emit.any():
+                total_words = int(base_np[-1]) + (int(nbytes_np[-1]) + 3) // 4
+                words_np = self._fetch_bucket(words, total_words)
+                raw = words_to_stripe_bytes(words_np, base_np, nbytes_np)
+                for s in range(self.n_stripes):
+                    if not emit[s]:
+                        continue
+                    if ovf_np[s]:  # pathological stripe: host-code its coeffs
+                        scans[s] = _entropy_encode_420(
+                            np.asarray(yq[s * yrows:(s + 1) * yrows]),
+                            np.asarray(cbq[s * crows:(s + 1) * crows]),
+                            np.asarray(crq[s * crows:(s + 1) * crows]))
+                    else:
+                        scans[s] = stuff_bytes(raw[s])
+            return self._assemble(emit, is_paint, scans)
 
         yq, cbq, crq, damage, new_prev = _device_encode(
             jnp.asarray(frame), self._prev, self._qy, self._qc, qsel,
@@ -204,47 +304,17 @@ class JpegStripeEncoder:
         )
         self._prev = new_prev
         yq, cbq, crq, damage = (np.asarray(a) for a in (yq, cbq, crq, damage))
-
-        damaged = damage > self.damage_threshold
-        if self._first_frame:
-            damaged[:] = True
-            self._first_frame = False
-
-        out: List[StripeOutput] = []
-        yrows = self.stripe_h // 8
-        crows = self.stripe_h // 16
-        for s in range(self.n_stripes):
-            emit = False
-            is_paint = False
-            if damaged[s]:
-                self._static_frames[s] = 0
-                self._painted[s] = False
-                emit = True
-                is_paint = bool(paint_candidate[s])  # quantized w/ HQ table
-            else:
-                self._static_frames[s] += 1
-                if paint_candidate[s]:
-                    emit = True
-                    is_paint = True
-                    self._painted[s] = True
-            if not emit:
-                continue
-            scan = _entropy_encode_420(
+        emit, is_paint = self._decide_emits(
+            damage > self.damage_threshold, paint_candidate)
+        scans = [
+            _entropy_encode_420(
                 yq[s * yrows:(s + 1) * yrows],
                 cbq[s * crows:(s + 1) * crows],
                 crq[s * crows:(s + 1) * crows],
-            )
-            qidx = 1 if is_paint else 0
-            jpeg = self._stripe_headers(qidx) + scan + EOI
-            out.append(
-                StripeOutput(
-                    y_start=s * self.stripe_h,
-                    height=self.stripe_h,
-                    jpeg=jpeg,
-                    is_paintover=is_paint,
-                )
-            )
-        return out
+            ) if emit[s] else b""
+            for s in range(self.n_stripes)
+        ]
+        return self._assemble(emit, is_paint, scans)
 
     def force_keyframe(self) -> None:
         """Make the next frame emit every stripe (client (re)connect)."""

@@ -1,0 +1,199 @@
+"""Pipelined frame encoder: overlaps device dispatch, D2H, and host assembly.
+
+JAX dispatch is asynchronous; the only blocking points are host reads. This
+wrapper keeps several frames in flight so per-frame round-trip latency
+(PCIe on production hosts, ~50-90 ms on tunneled dev chips) is hidden behind
+throughput: submit(frame_N) while harvesting frame_{N-depth}.
+
+The reference achieves the same overlap with pixelflux's capture/encode C++
+threads feeding an asyncio queue (selkies.py:2865-2894); here the "threads"
+are the device stream plus async host copies.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .device_entropy import stuff_bytes, words_to_stripe_bytes
+from .jpeg import JpegStripeEncoder, StripeOutput, _entropy_encode_420
+
+
+@dataclass
+class _InFlight:
+    seq: int
+    paint_candidate: np.ndarray
+    words: Any
+    nbytes: Any
+    base: Any
+    ovf: Any
+    damage: Any
+    yq: Any
+    cbq: Any
+    crq: Any
+    meta_done: bool = False
+    emit: Optional[np.ndarray] = None
+    is_paint: Optional[np.ndarray] = None
+    fetched_words: Any = None
+    meta: Tuple[Optional[np.ndarray], ...] = (None, None, None)
+
+
+class PipelinedJpegEncoder:
+    """Depth-N pipelined wrapper around a device-entropy JpegStripeEncoder.
+
+    Usage::
+
+        enc = PipelinedJpegEncoder(JpegStripeEncoder(w, h))
+        enc.submit(frame)                 # non-blocking dispatch
+        for seq, stripes in enc.poll():   # harvest whatever completed
+            ...
+        enc.flush()                       # drain everything (blocking)
+    """
+
+    def __init__(self, base: JpegStripeEncoder, depth: int = 3) -> None:
+        if base.entropy != "device":
+            raise ValueError("pipelining requires entropy='device'")
+        self.base = base
+        self.depth = depth
+        self._inflight: deque[_InFlight] = deque()
+        self._ready: List[Tuple[int, List[StripeOutput]]] = []
+        self._seq = 0
+
+    @property
+    def n_inflight(self) -> int:
+        return len(self._inflight)
+
+    def try_submit(self, frame: np.ndarray) -> Optional[int]:
+        """Dispatch one frame without ever blocking; returns None (frame
+        dropped) when the pipeline is full. This is the capture-loop entry
+        point: with a single asyncio loop owning all displays, blocking here
+        would stall every other client (SURVEY.md §5 concurrency invariant),
+        so a saturated pipeline degrades by dropping frames instead."""
+        self._advance_ready()
+        if len(self._inflight) >= self.depth:
+            return None
+        return self._dispatch(frame)
+
+    def submit(self, frame: np.ndarray) -> int:
+        """Dispatch one frame; blocks (harvesting the oldest) if full."""
+        while len(self._inflight) >= self.depth:
+            # Harvest the oldest synchronously to free a slot; the result is
+            # delivered by the next poll()/flush().
+            self._ready.append(self._drain_one())
+        return self._dispatch(frame)
+
+    def _dispatch(self, frame: np.ndarray) -> int:
+        b = self.base
+        frame = b._pad(np.asarray(frame, dtype=np.uint8))
+        paint_candidate = b._paint_candidates().copy()
+        # Optimistic mark: frames submitted while this one is in flight must
+        # not re-trigger the same paint-over (a damaged stripe clears the
+        # mark again at harvest in _decide_emits).
+        b._painted |= paint_candidate
+        qsel = jnp.asarray(paint_candidate.astype(np.int32))
+        words, nbytes, base_w, ovf, damage, new_prev, yq, cbq, crq = b._step(
+            jnp.asarray(frame), b._prev, b._qy, b._qc, qsel)
+        b._prev = new_prev
+        for a in (nbytes, base_w, ovf, damage):
+            a.copy_to_host_async()
+        item = _InFlight(
+            seq=self._seq, paint_candidate=paint_candidate,
+            words=words, nbytes=nbytes, base=base_w, ovf=ovf, damage=damage,
+            yq=yq, cbq=cbq, crq=crq,
+        )
+        self._seq += 1
+        self._inflight.append(item)
+        self._advance_ready()
+        return item.seq
+
+    # -- pipeline stages ---------------------------------------------------
+
+    def _advance_ready(self) -> None:
+        """Advance in-flight items in submission order (non-blocking).
+
+        ``_decide_emits`` mutates shared damage/paint history, so the meta
+        stage must run strictly in frame order: stop offering the meta stage
+        to an item until every earlier item has completed it.
+        """
+        meta_ok = True
+        for item in self._inflight:
+            if not meta_ok:
+                break
+            self._advance(item, block=False)
+            meta_ok = item.meta_done
+
+    def _advance(self, item: _InFlight, block: bool) -> bool:
+        """Move one item forward; returns True when fully harvestable."""
+        b = self.base
+        if not item.meta_done:
+            if not block and not all(
+                    a.is_ready() for a in (item.nbytes, item.base, item.ovf,
+                                           item.damage)):
+                return False
+            nbytes_np = np.asarray(item.nbytes)
+            base_np = np.asarray(item.base)
+            damage_np = np.asarray(item.damage)
+            ovf_np = np.asarray(item.ovf)
+            emit, is_paint = b._decide_emits(
+                damage_np > b.damage_threshold, item.paint_candidate)
+            item.emit, item.is_paint = emit, is_paint
+            item.meta = (nbytes_np, base_np, ovf_np)
+            item.meta_done = True
+            if emit.any():
+                total_words = int(base_np[-1]) + (int(nbytes_np[-1]) + 3) // 4
+                n = b._packer.bucket_words(total_words)
+                item.fetched_words = item.words[:n]
+                item.fetched_words.copy_to_host_async()
+        if item.fetched_words is not None:
+            if not block and not item.fetched_words.is_ready():
+                return False
+        return True
+
+    def _finish(self, item: _InFlight) -> List[StripeOutput]:
+        b = self.base
+        nbytes_np, base_np, ovf_np = item.meta
+        emit, is_paint = item.emit, item.is_paint
+        if not emit.any():
+            return []
+        words_np = np.asarray(item.fetched_words)
+        raw = words_to_stripe_bytes(words_np, base_np, nbytes_np)
+        yrows, crows = b.stripe_h // 8, b.stripe_h // 16
+        scans: List[bytes] = [b"" for _ in range(b.n_stripes)]
+        for s in range(b.n_stripes):
+            if not emit[s]:
+                continue
+            if ovf_np[s]:
+                scans[s] = _entropy_encode_420(
+                    np.asarray(item.yq[s * yrows:(s + 1) * yrows]),
+                    np.asarray(item.cbq[s * crows:(s + 1) * crows]),
+                    np.asarray(item.crq[s * crows:(s + 1) * crows]))
+            else:
+                scans[s] = stuff_bytes(raw[s])
+        return b._assemble(emit, is_paint, scans)
+
+    def _drain_one(self) -> Tuple[int, List[StripeOutput]]:
+        item = self._inflight.popleft()
+        self._advance(item, block=True)
+        return item.seq, self._finish(item)
+
+    # -- public harvest ----------------------------------------------------
+
+    def poll(self) -> List[Tuple[int, List[StripeOutput]]]:
+        """Harvest all completed frames (non-blocking, in order)."""
+        out, self._ready = self._ready, []
+        self._advance_ready()
+        while self._inflight and self._advance(self._inflight[0], block=False):
+            item = self._inflight.popleft()
+            out.append((item.seq, self._finish(item)))
+        return out
+
+    def flush(self) -> List[Tuple[int, List[StripeOutput]]]:
+        """Drain the pipeline (blocking)."""
+        out, self._ready = self._ready, []
+        while self._inflight:
+            out.append(self._drain_one())
+        return out

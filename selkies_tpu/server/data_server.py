@@ -1,0 +1,552 @@
+"""The WebSocket data/control server.
+
+Behavioral counterpart of the reference's ``DataStreamingServer``
+(selkies.py:803-2964): one asyncio server owning the client registry,
+settings negotiation, per-display capture/encode pipelines, the frame-ID
+backpressure gate, file upload, and the periodic stats feed. The media path
+differs by design: instead of pixelflux C++ threads pushing encoded stripes
+through a queue, each display runs an asyncio capture loop that submits raw
+frames to the pipelined TPU encoder and broadcasts the harvested stripes.
+
+Concurrency model (same invariant as the reference, SURVEY.md §5): a single
+asyncio loop owns all mutable state; the TPU pipeline is driven with
+non-blocking submits/polls from that loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Set
+
+from ..protocol.wire import (
+    FrameId,
+    pack_jpeg_stripe,
+    parse_text_message,
+)
+from ..settings import SETTING_DEFINITIONS, Settings
+from .backpressure import CHECK_INTERVAL_S, BackpressureState
+
+logger = logging.getLogger("selkies_tpu.server")
+
+STATS_INTERVAL_S = 5.0
+UPLOAD_DIR_ENV = "SELKIES_UPLOAD_DIR"
+
+
+def default_encoder_factory(
+    width: int, height: int, settings: Settings,
+    overrides: Optional[Dict[str, Any]] = None,
+):
+    from ..encoder.jpeg import JpegStripeEncoder
+    from ..encoder.pipeline import PipelinedJpegEncoder
+
+    ov = overrides or {}
+    return PipelinedJpegEncoder(
+        JpegStripeEncoder(
+            width,
+            height,
+            stripe_height=settings.tpu_stripe_height,
+            quality=ov.get("jpeg_quality", settings.jpeg_quality.default),
+            paintover_quality=ov.get(
+                "paint_over_jpeg_quality",
+                settings.paint_over_jpeg_quality.default),
+            use_paint_over_quality=ov.get(
+                "use_paint_over_quality",
+                settings.use_paint_over_quality.value),
+        ),
+        depth=3,
+    )
+
+
+def default_source_factory(width: int, height: int, fps: float):
+    from ..capture.x11 import X11Source
+    from ..capture.synthetic import SyntheticSource
+
+    if X11Source.available():
+        return X11Source(width, height, fps)
+    return SyntheticSource(width, height, fps, pattern="desktop")
+
+
+@dataclass
+class DisplayState:
+    display_id: str
+    ws: Any = None
+    width: int = 1024
+    height: int = 768
+    bp: BackpressureState = field(default_factory=BackpressureState)
+    capture_task: Optional[asyncio.Task] = None
+    backpressure_task: Optional[asyncio.Task] = None
+    video_active: bool = True
+    #: clamped per-client setting overrides from the SETTINGS handshake
+    overrides: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class _Upload:
+    path: str
+    fobj: Any
+    received: int = 0
+    size: int = 0
+
+
+class DataStreamingServer:
+    def __init__(
+        self,
+        settings: Settings,
+        app=None,
+        encoder_factory: Callable = default_encoder_factory,
+        source_factory: Callable = default_source_factory,
+        input_handler=None,
+        host: str = "0.0.0.0",
+    ) -> None:
+        self.settings = settings
+        self.app = app
+        self.input_handler = input_handler
+        self.encoder_factory = encoder_factory
+        self.source_factory = source_factory
+        self.host = host
+        self.port = settings.port
+
+        self.clients: Set[Any] = set()
+        self.display_clients: Dict[str, DisplayState] = {}
+        self._uploads: Dict[Any, _Upload] = {}
+        self._stats_task: Optional[asyncio.Task] = None
+        self._server = None
+        self._stop_event: Optional[asyncio.Event] = None
+        self.bytes_sent = 0
+        self.audio_pipeline = None  # wired by main() when audio is enabled
+
+    # ------------------------------------------------------------------
+    # broadcast primitives
+
+    def broadcast(self, message) -> None:
+        import websockets
+
+        if self.clients:
+            websockets.broadcast(self.clients, message)
+            if isinstance(message, (bytes, bytearray)):
+                self.bytes_sent += len(message) * len(self.clients)
+
+    def _viewers_of(self, display_id: str) -> Set[Any]:
+        """Primary-display media is fanned out to every client (sharing
+        modes); secondary displays go only to their owning client."""
+        if display_id == "primary":
+            return set(self.clients)
+        st = self.display_clients.get(display_id)
+        return {st.ws} if st and st.ws else set()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    async def run_server(self) -> None:
+        """Serve until stop() — with crash-restart supervision like the
+        reference's run loop (selkies.py:2453-2510)."""
+        import websockets.asyncio.server as ws_server
+
+        self._stop_event = asyncio.Event()
+        while not self._stop_event.is_set():
+            try:
+                async with ws_server.serve(
+                    self.ws_handler, self.host, self.port,
+                    compression=None, max_size=None,
+                ) as server:
+                    self._server = server
+                    logger.info("data server listening on %s:%d", self.host, self.port)
+                    await self._stop_event.wait()
+            except OSError as e:
+                logger.error("server bind failed (%s); retrying in 1s", e)
+                await asyncio.sleep(1.0)
+
+    async def stop(self) -> None:
+        for st in list(self.display_clients.values()):
+            await self._stop_display(st)
+        if self._stats_task:
+            self._stats_task.cancel()
+        if self._stop_event:
+            self._stop_event.set()
+
+    # ------------------------------------------------------------------
+    # connection handling
+
+    async def ws_handler(self, websocket) -> None:
+        self.clients.add(websocket)
+        try:
+            await websocket.send("MODE websockets")
+            if self.app and self.app.last_cursor_sent:
+                await websocket.send(
+                    "cursor," + json.dumps(self.app.last_cursor_sent))
+            await websocket.send(json.dumps(self.settings.schema_payload()))
+            if self._stats_task is None or self._stats_task.done():
+                self._stats_task = asyncio.create_task(self._stats_loop())
+            async for message in websocket:
+                if isinstance(message, (bytes, bytearray)):
+                    await self._handle_binary(websocket, message)
+                else:
+                    await self._handle_text(websocket, message)
+        except Exception as e:  # connection errors end the session
+            logger.debug("ws session ended: %r", e)
+        finally:
+            self.clients.discard(websocket)
+            self._uploads.pop(websocket, None)
+            for st in list(self.display_clients.values()):
+                if st.ws is websocket:
+                    await self._stop_display(st)
+                    del self.display_clients[st.display_id]
+
+    # ------------------------------------------------------------------
+    # text protocol
+
+    async def _handle_text(self, websocket, message: str) -> None:
+        msg = parse_text_message(message)
+        verb = msg.verb
+
+        if verb == "SETTINGS":
+            await self._on_settings(websocket, msg.json_body or "{}")
+        elif verb == "CLIENT_FRAME_ACK":
+            st = self._display_of(websocket)
+            if st and msg.args:
+                st.bp.on_client_ack(int(msg.args[0]))
+        elif verb == "r" and len(msg.args) >= 1:
+            await self._on_resize(websocket, msg.args)
+        elif verb == "START_VIDEO":
+            st = self._display_of(websocket)
+            if st:
+                st.video_active = True
+                await self._start_display(st)
+                await websocket.send("VIDEO_STARTED")
+        elif verb == "STOP_VIDEO":
+            st = self._display_of(websocket)
+            if st:
+                st.video_active = False
+                await self._stop_display(st)
+                await websocket.send("VIDEO_STOPPED")
+        elif verb == "START_AUDIO":
+            if self.audio_pipeline is not None:
+                await self.audio_pipeline.start()
+                self.broadcast("AUDIO_STARTED")
+        elif verb == "STOP_AUDIO":
+            if self.audio_pipeline is not None:
+                await self.audio_pipeline.stop()
+                self.broadcast("AUDIO_STOPPED")
+        elif verb == "FILE_UPLOAD_START":
+            await self._on_upload_start(websocket, msg.args)
+        elif verb == "FILE_UPLOAD_END":
+            up = self._uploads.pop(websocket, None)
+            if up:
+                up.fobj.close()
+                logger.info("upload finished: %s (%d bytes)", up.path, up.received)
+        elif verb == "FILE_UPLOAD_ERROR":
+            up = self._uploads.pop(websocket, None)
+            if up:
+                up.fobj.close()
+                os.unlink(up.path)
+        elif verb == "cmd":
+            if self.settings.command_enabled.value and msg.args:
+                await self._run_command(msg.args[0])
+        elif verb in ("kd", "ku", "kr", "m", "m2", "js", "cw", "cb", "cr",
+                      "cws", "cwd", "cwe", "cbs", "cbd", "cbe", "_f", "_l",
+                      "SET_NATIVE_CURSOR_RENDERING", "s"):
+            if verb == "_f":
+                st = self._display_of(websocket)
+                if st and msg.args:
+                    try:
+                        st.bp.on_client_fps(float(msg.args[0]))
+                    except ValueError:
+                        pass
+            if self.input_handler is not None:
+                await self.input_handler.on_message(message, self._display_id_of(websocket))
+        else:
+            logger.debug("unhandled message verb %r", verb)
+
+    # ------------------------------------------------------------------
+    # binary protocol (client → server)
+
+    async def _handle_binary(self, websocket, data: bytes) -> None:
+        if not data:
+            return
+        t = data[0]
+        if t == 0x01:  # file chunk
+            up = self._uploads.get(websocket)
+            if up:
+                up.fobj.write(data[1:])
+                up.received += len(data) - 1
+        elif t == 0x02:  # microphone PCM
+            if self.audio_pipeline is not None:
+                await self.audio_pipeline.on_mic_data(data[1:])
+
+    # ------------------------------------------------------------------
+    # settings negotiation
+
+    async def _on_settings(self, websocket, body: str) -> None:
+        try:
+            requested = json.loads(body)
+        except json.JSONDecodeError:
+            logger.warning("bad SETTINGS payload")
+            return
+        display_id = str(requested.get("displayId", "primary"))
+
+        if display_id != "primary" and not self.settings.second_screen.value:
+            await websocket.send("KILL Second screens are disabled on this server.")
+            await websocket.close()
+            return
+
+        st = self.display_clients.get(display_id)
+        if st and st.ws is not None and st.ws is not websocket:
+            # superseded client for this display: kill the old one
+            try:
+                await st.ws.send("KILL Display taken over by another client.")
+                await st.ws.close()
+            except Exception:
+                pass
+        if st is None:
+            st = DisplayState(display_id=display_id)
+            self.display_clients[display_id] = st
+        st.ws = websocket
+
+        known = {s.name for s in SETTING_DEFINITIONS}
+        applied: Dict[str, Any] = {}
+        for key, value in requested.items():
+            if key in ("displayId",):
+                continue
+            if key == "initialClientWidth":
+                st.width = max(16, int(value) & ~1)
+                continue
+            if key == "initialClientHeight":
+                st.height = max(16, int(value) & ~1)
+                continue
+            if key in known:
+                applied[key] = self.settings.clamp_client_value(key, value)
+        st.overrides.update(applied)
+        if "framerate" in applied:
+            st.bp.framerate = float(applied["framerate"])
+        logger.info("client settings for %s: %s", display_id, applied)
+
+        await self.reconfigure_display(st)
+        await self._reset_frame_ids_and_notify(st)
+
+    async def _on_resize(self, websocket, args) -> None:
+        if self.settings.is_manual_resolution_mode.value:
+            return
+        try:
+            res = args[0]
+            display_id = args[1] if len(args) > 1 else "primary"
+            w, h = (int(v) for v in res.split("x"))
+        except (ValueError, IndexError):
+            return
+        st = self.display_clients.get(display_id)
+        if not st:
+            return
+        st.width, st.height = max(16, w & ~1), max(16, h & ~1)
+        await self.reconfigure_display(st)
+        self.broadcast(json.dumps({
+            "type": "stream_resolution",
+            "width": st.width,
+            "height": st.height,
+        }))
+
+    # ------------------------------------------------------------------
+    # frame-id reset protocol
+
+    async def _reset_frame_ids_and_notify(self, st: DisplayState) -> None:
+        st.bp.reset()
+        message = f"PIPELINE_RESETTING {st.display_id}"
+        if st.display_id == "primary":
+            self.broadcast(message)
+        elif st.ws:
+            await st.ws.send(message)
+
+    # ------------------------------------------------------------------
+    # capture / encode pipeline per display
+
+    async def reconfigure_display(self, st: DisplayState) -> None:
+        await self._stop_display(st)
+        if st.video_active:
+            await self._start_display(st)
+
+    async def _start_display(self, st: DisplayState) -> None:
+        if st.capture_task and not st.capture_task.done():
+            return
+        st.capture_task = asyncio.create_task(self._capture_loop(st))
+        st.backpressure_task = asyncio.create_task(self._backpressure_loop(st))
+
+    async def _stop_display(self, st: DisplayState) -> None:
+        for attr in ("capture_task", "backpressure_task"):
+            task = getattr(st, attr)
+            if task and not task.done():
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            setattr(st, attr, None)
+
+    async def _capture_loop(self, st: DisplayState) -> None:
+        """Source frames → pipelined TPU encode → stripe broadcast."""
+        import websockets
+
+        fps = st.bp.framerate or 60.0
+        try:
+            encoder = self.encoder_factory(
+                st.width, st.height, self.settings, st.overrides)
+        except TypeError:  # factory without overrides support (tests, custom)
+            encoder = self.encoder_factory(st.width, st.height, self.settings)
+        source = self.source_factory(st.width, st.height, fps)
+        source.start()
+        frame_id = 0
+        interval = 1.0 / fps
+        next_tick = time.monotonic()
+        logger.info("capture loop started for %s (%dx%d@%g)",
+                    st.display_id, st.width, st.height, fps)
+        try:
+            while True:
+                if st.bp.send_enabled:
+                    frame = source.next_frame()
+                    if frame is not None:
+                        # never block the shared event loop: drop when full
+                        submit = getattr(encoder, "try_submit", encoder.submit)
+                        submit(frame)
+                for _seq, stripes in encoder.poll():
+                    if not stripes:
+                        continue
+                    frame_id = FrameId.next(frame_id)
+                    viewers = self._viewers_of(st.display_id)
+                    for s in stripes:
+                        chunk = pack_jpeg_stripe(frame_id, s.y_start, s.jpeg)
+                        if viewers:
+                            websockets.broadcast(viewers, chunk)
+                            self.bytes_sent += len(chunk) * len(viewers)
+                    st.bp.on_frame_sent(frame_id)
+                next_tick += interval
+                delay = next_tick - time.monotonic()
+                if delay < -1.0:  # fell badly behind; resynchronize
+                    next_tick = time.monotonic()
+                    delay = 0.0
+                await asyncio.sleep(max(0.0, delay))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("capture loop for %s crashed", st.display_id)
+        finally:
+            source.stop()
+
+    async def _backpressure_loop(self, st: DisplayState) -> None:
+        while True:
+            await asyncio.sleep(CHECK_INTERVAL_S)
+            st.bp.evaluate()
+
+    # ------------------------------------------------------------------
+    # file upload (path-sanitized, reference selkies.py:1843-1952)
+
+    def _upload_dir(self) -> str:
+        d = os.environ.get(UPLOAD_DIR_ENV) or os.path.join(
+            os.path.expanduser("~"), "Desktop")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    async def _on_upload_start(self, websocket, args) -> None:
+        if "upload" not in self.settings.file_transfers:
+            await websocket.send("FILE_UPLOAD_ERROR:GENERAL:uploads disabled")
+            return
+        rel_path, size = args[0], int(args[1] or 0)
+        root = os.path.realpath(self._upload_dir())
+        norm = os.path.normpath(rel_path)
+        if norm.startswith(("/", "\\")) or ".." in norm.split(os.sep):
+            await websocket.send(f"FILE_UPLOAD_ERROR:{rel_path}:invalid path")
+            return
+        target = os.path.realpath(os.path.join(root, norm))
+        if not target.startswith(root + os.sep):
+            await websocket.send(f"FILE_UPLOAD_ERROR:{rel_path}:invalid path")
+            return
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        old = self._uploads.pop(websocket, None)
+        if old:
+            old.fobj.close()
+        self._uploads[websocket] = _Upload(
+            path=target, fobj=open(target, "wb"), size=size)
+        logger.info("upload started: %s (%d bytes)", target, size)
+
+    # ------------------------------------------------------------------
+    # command execution
+
+    async def _run_command(self, command: str) -> None:
+        logger.info("exec: %s", command)
+        try:
+            await asyncio.create_subprocess_shell(
+                command,
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL,
+            )
+        except OSError as e:
+            logger.warning("command failed to spawn: %s", e)
+
+    # ------------------------------------------------------------------
+    # stats feed (reference selkies.py:2966-3083)
+
+    async def _stats_loop(self) -> None:
+        prev_bytes = 0
+        while True:
+            await asyncio.sleep(STATS_INTERVAL_S)
+            try:
+                stats = self._collect_system_stats()
+                self.broadcast(json.dumps(stats))
+                net = {
+                    "type": "network_stats",
+                    "bytes_sent_delta": self.bytes_sent - prev_bytes,
+                    "interval_s": STATS_INTERVAL_S,
+                }
+                prev_bytes = self.bytes_sent
+                self.broadcast(json.dumps(net))
+                tpu = self._collect_tpu_stats()
+                if tpu:
+                    self.broadcast(json.dumps(tpu))
+            except Exception:
+                logger.exception("stats loop error")
+
+    def _collect_system_stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"type": "system_stats"}
+        try:
+            import psutil
+
+            out["cpu_percent"] = psutil.cpu_percent()
+            mem = psutil.virtual_memory()
+            out["mem_total"] = mem.total
+            out["mem_used"] = mem.used
+        except ImportError:
+            la1, _, _ = os.getloadavg()
+            out["load_1m"] = la1
+        return out
+
+    def _collect_tpu_stats(self) -> Optional[Dict[str, Any]]:
+        """TPU occupancy takes the role of the reference's gpu_stats loop
+        (GPUtil, selkies.py:2988)."""
+        try:
+            import jax
+
+            devs = jax.devices()
+            stats = devs[0].memory_stats() if devs else None
+        except Exception:
+            return None
+        out = {"type": "gpu_stats", "device_count": len(devs),
+               "platform": devs[0].platform if devs else "none"}
+        if stats:
+            out["bytes_in_use"] = stats.get("bytes_in_use", 0)
+            out["bytes_limit"] = stats.get("bytes_limit", 0)
+        return out
+
+    # ------------------------------------------------------------------
+    # helpers
+
+    def _display_of(self, websocket) -> Optional[DisplayState]:
+        for st in self.display_clients.values():
+            if st.ws is websocket:
+                return st
+        # viewers (shared mode) ride the primary display
+        return self.display_clients.get("primary")
+
+    def _display_id_of(self, websocket) -> str:
+        st = self._display_of(websocket)
+        return st.display_id if st else "primary"

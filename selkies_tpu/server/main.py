@@ -1,0 +1,44 @@
+"""Server entry point wiring (reference: selkies.py:3133-3307 main())."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..settings import Settings
+from .app import StreamingApp
+from .data_server import DataStreamingServer
+
+
+def run(settings: Settings) -> int:
+    logging.basicConfig(
+        level=logging.DEBUG if settings.debug.value else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    return asyncio.run(_amain(settings)) or 0
+
+
+async def _amain(settings: Settings) -> int:
+    app = StreamingApp(settings)
+    server = DataStreamingServer(settings, app=app)
+    app.data_server = server
+
+    input_handler = None
+    try:
+        from ..inputs.handler import InputHandler
+
+        input_handler = InputHandler(app=app, settings=settings)
+        server.input_handler = input_handler
+    except Exception as e:  # no X display etc. — stream-only mode
+        logging.getLogger("selkies_tpu").warning("input plane disabled: %s", e)
+
+    tasks = [asyncio.create_task(server.run_server())]
+    if input_handler is not None:
+        tasks.extend(input_handler.start_tasks())
+    try:
+        await asyncio.gather(*tasks)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await server.stop()
+    return 0

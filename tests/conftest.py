@@ -1,13 +1,33 @@
-"""Test configuration: force the JAX CPU backend with 8 virtual devices.
+"""Test configuration: force a clean JAX CPU backend with 8 virtual devices.
 
 All tests run on CPU (the real chip is reserved for bench.py); multi-chip
 sharding tests use the 8 virtual devices as a simulated mesh, per the test
 strategy in SURVEY.md §4.
+
+Why the re-exec: the ambient environment injects a TPU PJRT plugin into
+every interpreter via sitecustomize (PYTHONPATH=/root/.axon_site) gated on
+``PALLAS_AXON_POOL_IPS``, and that registration can block every JAX backend
+init — including CPU — when the device tunnel is wedged. By the time this
+conftest runs, sitecustomize has already executed, so scrubbing the
+environment and re-exec'ing pytest is the only reliable isolation.
 """
 
 import os
+import sys
 
-# Hard override: the ambient environment may pin JAX_PLATFORMS to the TPU.
+_SCRUB = ("PALLAS_AXON_POOL_IPS",)
+
+if any(v in os.environ for v in _SCRUB):
+    env = dict(os.environ)
+    for v in _SCRUB:
+        env.pop(v, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -118,3 +118,55 @@ def test_native_entropy_matches_python_oracle():
         out, cap)
     assert n > 0
     assert out[:n].tobytes() == entropy_py.encode_scan_420(y, cb, cr)
+
+
+def test_device_entropy_mode_matches_host_mode():
+    h, w = 128, 160
+    frame = smooth_frame(h, w)
+    frames = [frame, frame, np.roll(frame, 5, axis=1)]
+    enc_d = JpegStripeEncoder(w, h, stripe_height=64, quality=60, entropy="device")
+    enc_h = JpegStripeEncoder(w, h, stripe_height=64, quality=60, entropy="host")
+    for f in frames:
+        out_d = enc_d.encode_frame(f)
+        out_h = enc_h.encode_frame(f)
+        assert [(s.y_start, s.jpeg) for s in out_d] == \
+               [(s.y_start, s.jpeg) for s in out_h]
+
+
+def test_pipelined_encoder_matches_sync():
+    from selkies_tpu.encoder.pipeline import PipelinedJpegEncoder
+    h, w = 128, 96
+    frames = [smooth_frame(h, w), smooth_frame(h, w),
+              np.roll(smooth_frame(h, w), 7, axis=0),
+              np.roll(smooth_frame(h, w), 9, axis=1)]
+    sync = JpegStripeEncoder(w, h, stripe_height=64, quality=55)
+    want = [[(s.y_start, s.jpeg) for s in sync.encode_frame(f)] for f in frames]
+
+    pipe = PipelinedJpegEncoder(
+        JpegStripeEncoder(w, h, stripe_height=64, quality=55), depth=3)
+    got = {}
+    for f in frames:
+        pipe.submit(f)
+        for seq, stripes in pipe.poll():
+            got[seq] = [(s.y_start, s.jpeg) for s in stripes]
+    for seq, stripes in pipe.flush():
+        got[seq] = [(s.y_start, s.jpeg) for s in stripes]
+    assert [got[i] for i in range(len(frames))] == want
+
+
+def test_pipelined_paintover_not_duplicated():
+    """With frames in flight, a paint-over must fire exactly once."""
+    from selkies_tpu.encoder.pipeline import PipelinedJpegEncoder
+    h, w = 64, 64
+    frame = smooth_frame(h, w)
+    pipe = PipelinedJpegEncoder(
+        JpegStripeEncoder(w, h, stripe_height=64, quality=40,
+                          paintover_quality=95, paint_over_trigger_frames=3),
+        depth=3)
+    outs = []
+    for _ in range(12):
+        pipe.submit(frame)
+        outs.extend(s for _, st in pipe.poll() for s in st)
+    outs.extend(s for _, st in pipe.flush() for s in st)
+    paint = [s for s in outs if s.is_paintover]
+    assert len(paint) == 1

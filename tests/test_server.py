@@ -1,0 +1,308 @@
+"""End-to-end server tests over a real WebSocket, with a fake encoder
+(no TPU/jit) standing in for the tpuenc pipeline."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+import websockets
+
+from selkies_tpu.encoder.jpeg import StripeOutput
+from selkies_tpu.protocol import unpack_binary, VideoStripe
+from selkies_tpu.server.app import StreamingApp
+from selkies_tpu.server.data_server import DataStreamingServer
+from selkies_tpu.settings import Settings
+
+
+class FakeEncoder:
+    """Pipelined-encoder lookalike: every submitted frame yields one stripe."""
+
+    def __init__(self):
+        self.submitted = 0
+        self._ready = []
+
+    def submit(self, frame):
+        self.submitted += 1
+        self._ready.append(
+            (self.submitted,
+             [StripeOutput(y_start=0, height=64,
+                           jpeg=b"\xff\xd8FAKE%d" % self.submitted + b"\xff\xd9",
+                           is_paintover=False)]))
+
+    def poll(self):
+        out, self._ready = self._ready, []
+        return out
+
+    def flush(self):
+        return self.poll()
+
+
+class FakeSource:
+    def __init__(self, width, height, fps):
+        self.width, self.height, self.fps = width, height, fps
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def next_frame(self):
+        return np.zeros((self.height, self.width, 3), np.uint8)
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def make_server(tmp_path, **settings_env):
+    env = {"SELKIES_PORT": "0"}
+    env.update(settings_env)
+    settings = Settings(argv=[], env=env)
+    app = StreamingApp(settings)
+    encoders = []
+
+    def encoder_factory(w, h, s):
+        enc = FakeEncoder()
+        encoders.append(enc)
+        return enc
+
+    server = DataStreamingServer(
+        settings, app=app,
+        encoder_factory=encoder_factory,
+        source_factory=lambda w, h, fps: FakeSource(w, h, fps),
+        host="127.0.0.1",
+    )
+    app.data_server = server
+    os.environ["SELKIES_UPLOAD_DIR"] = str(tmp_path / "uploads")
+    return server, app, encoders
+
+
+async def start_on_free_port(server):
+    import websockets.asyncio.server as ws_server
+
+    server._stop_event = asyncio.Event()
+    srv = await ws_server.serve(
+        server.ws_handler, "127.0.0.1", 0, compression=None, max_size=None)
+    server._server = srv
+    port = srv.sockets[0].getsockname()[1]
+    return srv, port
+
+
+async def handshake(ws):
+    assert await ws.recv() == "MODE websockets"
+    schema = json.loads(await ws.recv())
+    assert schema["type"] == "server_settings"
+    return schema
+
+
+@pytest.mark.anyio
+async def test_handshake_and_video_flow(tmp_path):
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            schema = await handshake(ws)
+            assert "encoder" in schema["settings"]
+
+            await ws.send('SETTINGS,' + json.dumps({
+                "displayId": "primary",
+                "initialClientWidth": 320,
+                "initialClientHeight": 240,
+                "framerate": 30,
+            }))
+            # PIPELINE_RESETTING broadcast then binary stripes (stats JSON
+            # may interleave)
+            while True:
+                reset = await asyncio.wait_for(ws.recv(), 5)
+                if reset == "PIPELINE_RESETTING primary":
+                    break
+            while True:
+                frame = await asyncio.wait_for(ws.recv(), 5)
+                if isinstance(frame, bytes):
+                    break
+            f = unpack_binary(frame)
+            assert isinstance(f, VideoStripe)
+            assert f.payload.startswith(b"\xff\xd8FAKE")
+            assert f.frame_id == 1
+
+            # ACK flows into backpressure state
+            await ws.send(f"CLIENT_FRAME_ACK {f.frame_id}")
+            await asyncio.sleep(0.1)
+            st = server.display_clients["primary"]
+            assert st.bp.acknowledged_frame_id == f.frame_id
+    finally:
+        await server.stop()
+        srv.close()
+
+
+@pytest.mark.anyio
+async def test_stop_start_video(tmp_path):
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await handshake(ws)
+            await ws.send('SETTINGS,{"displayId": "primary"}')
+            await asyncio.wait_for(ws.recv(), 5)  # PIPELINE_RESETTING
+
+            await ws.send("STOP_VIDEO")
+            # drain until VIDEO_STOPPED
+            while True:
+                m = await asyncio.wait_for(ws.recv(), 5)
+                if m == "VIDEO_STOPPED":
+                    break
+            st = server.display_clients["primary"]
+            assert st.capture_task is None
+
+            await ws.send("START_VIDEO")
+            while True:
+                m = await asyncio.wait_for(ws.recv(), 5)
+                if m == "VIDEO_STARTED":
+                    break
+            assert st.capture_task is not None
+    finally:
+        await server.stop()
+        srv.close()
+
+
+@pytest.mark.anyio
+async def test_second_screen_disabled_kills_client(tmp_path):
+    server, app, encoders = make_server(
+        tmp_path, SELKIES_SECOND_SCREEN="false")
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await handshake(ws)
+            await ws.send('SETTINGS,{"displayId": "display2"}')
+            while True:
+                msg = await asyncio.wait_for(ws.recv(), 5)
+                if isinstance(msg, str) and msg.startswith("KILL"):
+                    break
+    finally:
+        await server.stop()
+        srv.close()
+
+
+@pytest.mark.anyio
+async def test_file_upload_and_path_traversal(tmp_path):
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await handshake(ws)
+            await ws.send("FILE_UPLOAD_START:sub/ok.txt:9")
+            await ws.send(b"\x01hello")
+            await ws.send(b"\x01 world")
+            await ws.send("FILE_UPLOAD_END:sub/ok.txt")
+            await asyncio.sleep(0.2)
+            target = tmp_path / "uploads" / "sub" / "ok.txt"
+            assert target.read_bytes() == b"hello world"
+
+            await ws.send("FILE_UPLOAD_START:../evil.txt:4")
+            msg = await asyncio.wait_for(ws.recv(), 5)
+            assert msg.startswith("FILE_UPLOAD_ERROR")
+            assert not (tmp_path / "evil.txt").exists()
+    finally:
+        await server.stop()
+        srv.close()
+
+
+@pytest.mark.anyio
+async def test_resize_broadcasts_resolution(tmp_path):
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await handshake(ws)
+            await ws.send('SETTINGS,{"displayId": "primary"}')
+            await asyncio.wait_for(ws.recv(), 5)
+            await ws.send("r,1280x720,primary")
+            while True:
+                m = await asyncio.wait_for(ws.recv(), 5)
+                if isinstance(m, str) and m.startswith("{"):
+                    d = json.loads(m)
+                    if d.get("type") == "stream_resolution":
+                        assert (d["width"], d["height"]) == (1280, 720)
+                        break
+            assert server.display_clients["primary"].width == 1280
+    finally:
+        await server.stop()
+        srv.close()
+
+
+def test_backpressure_state_logic():
+    from selkies_tpu.server.backpressure import BackpressureState
+
+    bp = BackpressureState(framerate=60)
+    t = 1000.0
+    bp.reset(now=t)
+    # healthy: acked close behind sent
+    for i in range(1, 100):
+        bp.on_frame_sent(i, now=t + i * 0.016)
+    bp.on_client_ack(95, now=t + 99 * 0.016)
+    assert bp.evaluate(now=t + 99 * 0.016) is True
+
+    # desync beyond 2s of frames → gate closes
+    bp2 = BackpressureState(framerate=60)
+    bp2.reset(now=t)
+    for i in range(1, 300):
+        bp2.on_frame_sent(i, now=t + i * 0.016)
+    bp2.on_client_ack(10, now=t + 1.0)
+    assert bp2.evaluate(now=t + 5.0) is False  # 289 frames > 120 allowed
+
+    # stall: no ACK for > 4s
+    bp3 = BackpressureState(framerate=60)
+    bp3.reset(now=t)
+    bp3.on_frame_sent(1, now=t)
+    bp3.on_client_ack(1, now=t)
+    assert bp3.evaluate(now=t + 0.1) is True
+    assert bp3.evaluate(now=t + 4.5) is False
+
+    # legitimate wrap: sender wrapped past 65535, client still far behind —
+    # modular desync sees the true 5539-frame gap and keeps the gate closed
+    # (the reference's abs() heuristic would wrongly treat this as an anomaly)
+    bp4 = BackpressureState(framerate=60)
+    bp4.reset(now=t)
+    bp4.on_frame_sent(3, now=t)
+    bp4.on_client_ack(60000, now=t)
+    assert bp4.evaluate(now=t + 1) is False
+
+    # true anomaly: client ACKs an id "ahead" of the sender → reset posture
+    bp5 = BackpressureState(framerate=60)
+    bp5.reset(now=t)
+    bp5.on_frame_sent(5, now=t)
+    bp5.on_client_ack(10, now=t)
+    assert bp5.evaluate(now=t + 1) is True
+
+
+@pytest.mark.anyio
+async def test_settings_overrides_reach_encoder_factory(tmp_path):
+    settings = Settings(argv=[], env={})
+    seen = {}
+
+    def factory(w, h, s, overrides=None):
+        seen.update(overrides or {})
+        return FakeEncoder()
+
+    server = DataStreamingServer(
+        settings, app=None, encoder_factory=factory,
+        source_factory=lambda w, h, fps: FakeSource(w, h, fps),
+        host="127.0.0.1")
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await handshake(ws)
+            await ws.send('SETTINGS,' + json.dumps(
+                {"displayId": "primary", "jpeg_quality": 77,
+                 "framerate": 24}))
+            await asyncio.sleep(0.3)
+            assert seen.get("jpeg_quality") == 77
+            st = server.display_clients["primary"]
+            assert st.bp.framerate == 24.0
+    finally:
+        await server.stop()
+        srv.close()
