@@ -1,0 +1,79 @@
+"""Headline benchmark: 1080p streaming-encode throughput on one chip.
+
+Mirrors the reference's headline claim — 60 fps @ 1920×1080 desktop encode
+(reference docs/README.md:12, docs/design.md:11; BASELINE.md) — against the
+tpuenc JPEG-stripe profile with device-side entropy coding, run through the
+pipelined (depth-3, dispatch/D2H-overlapped) encoder exactly as the streaming
+server drives it.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "fps", "vs_baseline": N}
+vs_baseline is the ratio against the reference's 60 fps 1080p target.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_FPS = 60.0  # reference headline: 60 fps @ 1080p
+W, H = 1920, 1080
+WARMUP_FRAMES = 12
+BENCH_FRAMES = 180
+MAX_SECONDS = 60.0
+
+
+def main() -> None:
+    from selkies_tpu.capture.synthetic import SyntheticSource
+    from selkies_tpu.encoder.jpeg import JpegStripeEncoder
+    from selkies_tpu.encoder.pipeline import PipelinedJpegEncoder
+
+    # "scroll" damages every stripe every frame — full-frame work, no
+    # damage-gating shortcuts; this is the honest worst-ish case.
+    src = SyntheticSource(W, H, pattern="scroll")
+    frames = [src.next_frame() for _ in range(16)]
+
+    enc = PipelinedJpegEncoder(JpegStripeEncoder(W, H), depth=3)
+
+    done = 0
+    for i in range(WARMUP_FRAMES):  # includes compile
+        enc.submit(frames[i % len(frames)])
+        for _ in enc.poll():
+            pass
+    for _ in enc.flush():
+        pass
+
+    start = time.perf_counter()
+    submitted = 0
+    total_bytes = 0
+    while submitted < BENCH_FRAMES:
+        enc.submit(frames[submitted % len(frames)])
+        submitted += 1
+        for _seq, stripes in enc.poll():
+            done += 1
+            total_bytes += sum(len(s.jpeg) for s in stripes)
+        if time.perf_counter() - start > MAX_SECONDS:
+            break
+    for _seq, stripes in enc.flush():
+        done += 1
+        total_bytes += sum(len(s.jpeg) for s in stripes)
+    elapsed = time.perf_counter() - start
+
+    fps = done / elapsed if elapsed > 0 else 0.0
+    result = {
+        "metric": "tpuenc_jpeg_1080p_encode_fps",
+        "value": round(fps, 2),
+        "unit": "fps",
+        "vs_baseline": round(fps / BASELINE_FPS, 3),
+        "frames": done,
+        "elapsed_s": round(elapsed, 2),
+        "mean_frame_kb": round(total_bytes / max(done, 1) / 1024, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""Multi-session / multi-chip parallelism (SURVEY.md §2.7, BASELINE config 5).
+
+The reference is single-node: one pixelflux C++ thread pool per display.  The
+TPU-native scale axis is a 2-D device mesh:
+
+  * ``session`` — data parallelism over concurrent desktop sessions (the
+    "8× 1080p60 on v5e-8" north star batches one frame per session per tick);
+  * ``stripe``  — spatial parallelism over horizontal frame bands (the
+    reference's stripe-thread axis, SURVEY.md §2.7 row 1), sharding the
+    height dimension so one session's frame can span several chips.
+
+Collectives ride ICI: per-session coded-size estimates are ``psum``-ed over
+the stripe axis (a session's stripes live on different chips) and globally
+over the session axis to drive the shared rate controller.
+"""
+
+from .mesh import make_mesh, make_batched_step, BatchedSessionEncoder
+
+__all__ = ["make_mesh", "make_batched_step", "BatchedSessionEncoder"]

@@ -1,0 +1,156 @@
+"""Device mesh construction and the sharded multi-session encode step.
+
+Replaces (TPU-natively) the reference's per-display C++ thread-pool
+parallelism (pixelflux capture/encode threads, reference selkies.py:2846-2904)
+with SPMD over a ``jax.sharding.Mesh``: sessions are data-parallel, a frame's
+height is spatially sharded on stripe boundaries, and the global rate signal
+is a psum over both axes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..encoder.jpeg import _encode_body
+
+
+def make_mesh(
+    devices=None,
+    stripe_axis: Optional[int] = None,
+) -> Mesh:
+    """Build a ("session", "stripe") mesh over the given (or all) devices.
+
+    ``stripe_axis`` defaults to 2 when the device count is even so both mesh
+    axes are exercised, else 1 (pure session parallelism).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if stripe_axis is None:
+        stripe_axis = 2 if (n % 2 == 0 and n > 1) else 1
+    if n % stripe_axis:
+        raise ValueError(f"{n} devices not divisible by stripe_axis={stripe_axis}")
+    arr = np.asarray(devices).reshape(n // stripe_axis, stripe_axis)
+    return Mesh(arr, ("session", "stripe"))
+
+
+def make_batched_step(mesh: Mesh, stripe_h: int):
+    """Jitted sharded step: encode one frame for every session in the batch.
+
+    fn(frames, prev, qy, qc, qsel) with
+      frames/prev [N, H, W, 3] uint8  — sharded (session, stripe) on (N, H);
+      qy/qc       [nq, 8, 8] float32  — replicated quant tables;
+      qsel        [N, S] int32        — per-session per-stripe table index.
+    Returns (yq, cbq, crq, damage, new_prev, session_bits, total_bits):
+      coefficient planes and damage sharded like their inputs, ``new_prev``
+      for the next tick (donated chain), per-session nonzero-coefficient
+      counts [N] (the rate-control feedback, psum over "stripe"), and the
+      replicated global total (psum over "session" too).
+    """
+    n_session, n_stripe = mesh.shape["session"], mesh.shape["stripe"]
+
+    def local_step(frames, prev, qy, qc, qsel):
+        enc = functools.partial(_encode_body, stripe_h=stripe_h)
+        yq, cbq, crq, damage, new_prev = jax.vmap(
+            enc, in_axes=(0, 0, None, None, 0))(frames, prev, qy, qc, qsel)
+        nz = (
+            (yq != 0).sum(axis=(1, 2, 3))
+            + (cbq != 0).sum(axis=(1, 2, 3))
+            + (crq != 0).sum(axis=(1, 2, 3))
+        ).astype(jnp.int32)
+        # A session's stripes live on different chips along "stripe": the
+        # per-session coded-size estimate is the ICI psum across that axis.
+        session_bits = jax.lax.psum(nz, "stripe")
+        total_bits = jax.lax.psum(session_bits.sum(), "session")
+        return yq, cbq, crq, damage, new_prev, session_bits, total_bits
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(
+            P("session", "stripe"),  # frames
+            P("session", "stripe"),  # prev
+            P(),                     # qy
+            P(),                     # qc
+            P("session", "stripe"),  # qsel
+        ),
+        out_specs=(
+            P("session", "stripe"),  # yq
+            P("session", "stripe"),  # cbq
+            P("session", "stripe"),  # crq
+            P("session", "stripe"),  # damage
+            P("session", "stripe"),  # new_prev
+            P("session"),            # session_bits
+            P(),                     # total_bits
+        ),
+    )
+    return jax.jit(sharded, donate_argnums=(1,)), (n_session, n_stripe)
+
+
+class BatchedSessionEncoder:
+    """Frame-batched multi-session encoder (BASELINE config 5 skeleton).
+
+    Holds the sharded previous-frame state on device and dispatches one
+    mesh-wide step per tick. Geometry constraints: ``height`` must divide
+    evenly into ``mesh stripe axis × stripe_h`` bands and ``n_sessions``
+    into the session axis.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        n_sessions: int,
+        width: int,
+        height: int,
+        stripe_h: int = 64,
+        quality: int = 40,
+        paintover_quality: int = 90,
+    ) -> None:
+        from ..ops.quant import quality_scaled_tables
+
+        n_sess_ax = mesh.shape["session"]
+        n_stripe_ax = mesh.shape["stripe"]
+        if n_sessions % n_sess_ax:
+            raise ValueError(
+                f"{n_sessions} sessions not divisible by session axis {n_sess_ax}")
+        if height % (n_stripe_ax * stripe_h):
+            raise ValueError(
+                f"height {height} not divisible by stripe axis {n_stripe_ax}"
+                f" × stripe_h {stripe_h}")
+        if width % 16:
+            raise ValueError("width must be a multiple of 16 (4:2:0 MCUs)")
+        self.mesh = mesh
+        self.n_sessions = n_sessions
+        self.width, self.height, self.stripe_h = width, height, stripe_h
+        self.n_stripes = height // stripe_h
+
+        ly, lc = quality_scaled_tables(quality)
+        py, pc = quality_scaled_tables(paintover_quality)
+        self._qy = jnp.stack([jnp.asarray(ly, jnp.float32),
+                              jnp.asarray(py, jnp.float32)])
+        self._qc = jnp.stack([jnp.asarray(lc, jnp.float32),
+                              jnp.asarray(pc, jnp.float32)])
+
+        self._step, _ = make_batched_step(mesh, stripe_h)
+        frame_sharding = NamedSharding(mesh, P("session", "stripe"))
+        self._frame_sharding = frame_sharding
+        self._prev = jax.device_put(
+            jnp.zeros((n_sessions, height, width, 3), jnp.uint8), frame_sharding)
+
+    def step(self, frames: np.ndarray, qsel: Optional[np.ndarray] = None):
+        """Encode one frame per session; returns
+        (yq, cbq, crq, damage, session_bits, total_bits)."""
+        if qsel is None:
+            qsel = np.zeros((self.n_sessions, self.n_stripes), np.int32)
+        frames_d = jax.device_put(
+            jnp.asarray(frames, jnp.uint8), self._frame_sharding)
+        yq, cbq, crq, damage, self._prev, session_bits, total_bits = self._step(
+            frames_d, self._prev, self._qy, self._qc,
+            jnp.asarray(qsel, jnp.int32))
+        return yq, cbq, crq, damage, session_bits, total_bits
