@@ -93,6 +93,29 @@ _device_encode = functools.partial(
 )(_encode_body)
 
 
+@functools.lru_cache(maxsize=32)
+def _device_pipeline(pad_h: int, pad_w: int, stripe_h: int):
+    """Shared (packer, jitted step) per frame geometry.
+
+    Keyed like :func:`device_entropy.scan_geometry` so reconnects/resizes to
+    an already-seen resolution reuse the compiled executable instead of
+    retracing a fresh per-instance closure (a multi-second stall on the
+    shared event loop otherwise)."""
+    from .device_entropy import DeviceEntropyPacker
+
+    packer = DeviceEntropyPacker(pad_h, pad_w, stripe_h)
+    packer_fn = packer._pack_fn
+
+    @functools.partial(jax.jit, donate_argnames=("prev",))
+    def step(frame, prev, qy, qc, qsel):
+        yq, cbq, crq, damage, new_prev = _encode_body(
+            frame, prev, qy, qc, qsel, stripe_h=stripe_h)
+        words, nbytes, base, ovf = packer_fn(yq, cbq, crq)
+        return words, nbytes, base, ovf, damage, new_prev, yq, cbq, crq
+
+    return packer, step
+
+
 def _entropy_encode_420(y: np.ndarray, cb: np.ndarray, cr: np.ndarray) -> bytes:
     lib = entropy_lib()
     if lib is None:
@@ -162,20 +185,8 @@ class JpegStripeEncoder:
         self._first_frame = True
 
         if entropy == "device":
-            from .device_entropy import DeviceEntropyPacker
-
-            self._packer = DeviceEntropyPacker(self.pad_h, self.pad_w, self.stripe_h)
-            packer_fn = self._packer._pack_fn
-            stripe_h = self.stripe_h
-
-            @functools.partial(jax.jit, donate_argnames=("prev",))
-            def step(frame, prev, qy, qc, qsel):
-                yq, cbq, crq, damage, new_prev = _encode_body(
-                    frame, prev, qy, qc, qsel, stripe_h=stripe_h)
-                words, nbytes, base, ovf = packer_fn(yq, cbq, crq)
-                return words, nbytes, base, ovf, damage, new_prev, yq, cbq, crq
-
-            self._step = step
+            self._packer, self._step = _device_pipeline(
+                self.pad_h, self.pad_w, self.stripe_h)
 
     # -- configuration -----------------------------------------------------
 
@@ -263,6 +274,33 @@ class JpegStripeEncoder:
         slice shape compiles once; bucketing bounds the executable count)."""
         return np.asarray(words[:self._packer.bucket_words(total_words)])
 
+    @staticmethod
+    def total_packed_words(base_np: np.ndarray, nbytes_np: np.ndarray) -> int:
+        """Packed-word count of the whole frame (last stripe's base + span)."""
+        return int(base_np[-1]) + (int(nbytes_np[-1]) + 3) // 4
+
+    def _scans_from_packed(
+        self, words_np, base_np, nbytes_np, ovf_np, emit, yq, cbq, crq,
+    ) -> List[bytes]:
+        """Per-stripe entropy scans from the device-packed word buffer;
+        overflowed stripes fall back to host-coding their coefficients."""
+        from .device_entropy import stuff_bytes, words_to_stripe_bytes
+
+        yrows, crows = self.stripe_h // 8, self.stripe_h // 16
+        raw = words_to_stripe_bytes(words_np, base_np, nbytes_np)
+        scans: List[bytes] = [b""] * self.n_stripes
+        for s in range(self.n_stripes):
+            if not emit[s]:
+                continue
+            if ovf_np[s]:  # pathological stripe: host-code its coeffs
+                scans[s] = _entropy_encode_420(
+                    np.asarray(yq[s * yrows:(s + 1) * yrows]),
+                    np.asarray(cbq[s * crows:(s + 1) * crows]),
+                    np.asarray(crq[s * crows:(s + 1) * crows]))
+            else:
+                scans[s] = stuff_bytes(raw[s])
+        return scans
+
     def encode_frame(self, frame: np.ndarray) -> List[StripeOutput]:
         """Encode one [H, W, 3] uint8 RGB frame; returns changed stripes only."""
         frame = self._pad(np.asarray(frame, dtype=np.uint8))
@@ -272,8 +310,6 @@ class JpegStripeEncoder:
         crows = self.stripe_h // 16
 
         if self.entropy == "device":
-            from .device_entropy import stuff_bytes, words_to_stripe_bytes
-
             words, nbytes, base, ovf, damage, new_prev, yq, cbq, crq = self._step(
                 jnp.asarray(frame), self._prev, self._qy, self._qc, qsel)
             self._prev = new_prev
@@ -283,19 +319,10 @@ class JpegStripeEncoder:
                 damage_np > self.damage_threshold, paint_candidate)
             scans: List[bytes] = [b""] * self.n_stripes
             if emit.any():
-                total_words = int(base_np[-1]) + (int(nbytes_np[-1]) + 3) // 4
-                words_np = self._fetch_bucket(words, total_words)
-                raw = words_to_stripe_bytes(words_np, base_np, nbytes_np)
-                for s in range(self.n_stripes):
-                    if not emit[s]:
-                        continue
-                    if ovf_np[s]:  # pathological stripe: host-code its coeffs
-                        scans[s] = _entropy_encode_420(
-                            np.asarray(yq[s * yrows:(s + 1) * yrows]),
-                            np.asarray(cbq[s * crows:(s + 1) * crows]),
-                            np.asarray(crq[s * crows:(s + 1) * crows]))
-                    else:
-                        scans[s] = stuff_bytes(raw[s])
+                words_np = self._fetch_bucket(
+                    words, self.total_packed_words(base_np, nbytes_np))
+                scans = self._scans_from_packed(
+                    words_np, base_np, nbytes_np, ovf_np, emit, yq, cbq, crq)
             return self._assemble(emit, is_paint, scans)
 
         yq, cbq, crq, damage, new_prev = _device_encode(

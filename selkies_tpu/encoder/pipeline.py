@@ -19,8 +19,7 @@ from typing import Any, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from .device_entropy import stuff_bytes, words_to_stripe_bytes
-from .jpeg import JpegStripeEncoder, StripeOutput, _entropy_encode_420
+from .jpeg import JpegStripeEncoder, StripeOutput
 
 
 @dataclass
@@ -144,8 +143,8 @@ class PipelinedJpegEncoder:
             item.meta = (nbytes_np, base_np, ovf_np)
             item.meta_done = True
             if emit.any():
-                total_words = int(base_np[-1]) + (int(nbytes_np[-1]) + 3) // 4
-                n = b._packer.bucket_words(total_words)
+                n = b._packer.bucket_words(
+                    b.total_packed_words(base_np, nbytes_np))
                 item.fetched_words = item.words[:n]
                 item.fetched_words.copy_to_host_async()
         if item.fetched_words is not None:
@@ -159,20 +158,9 @@ class PipelinedJpegEncoder:
         emit, is_paint = item.emit, item.is_paint
         if not emit.any():
             return []
-        words_np = np.asarray(item.fetched_words)
-        raw = words_to_stripe_bytes(words_np, base_np, nbytes_np)
-        yrows, crows = b.stripe_h // 8, b.stripe_h // 16
-        scans: List[bytes] = [b"" for _ in range(b.n_stripes)]
-        for s in range(b.n_stripes):
-            if not emit[s]:
-                continue
-            if ovf_np[s]:
-                scans[s] = _entropy_encode_420(
-                    np.asarray(item.yq[s * yrows:(s + 1) * yrows]),
-                    np.asarray(item.cbq[s * crows:(s + 1) * crows]),
-                    np.asarray(item.crq[s * crows:(s + 1) * crows]))
-            else:
-                scans[s] = stuff_bytes(raw[s])
+        scans = b._scans_from_packed(
+            np.asarray(item.fetched_words), base_np, nbytes_np, ovf_np,
+            emit, item.yq, item.cbq, item.crq)
         return b._assemble(emit, is_paint, scans)
 
     def _drain_one(self) -> Tuple[int, List[StripeOutput]]:

@@ -78,6 +78,10 @@ class DisplayState:
     width: int = 1024
     height: int = 768
     bp: BackpressureState = field(default_factory=BackpressureState)
+    #: serializes start/stop/reconfigure (they await mid-flight, so two
+    #: concurrent calls could otherwise both pass the is-running guard and
+    #: spawn duplicate capture loops)
+    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
     capture_task: Optional[asyncio.Task] = None
     backpressure_task: Optional[asyncio.Task] = None
     video_active: bool = True
@@ -88,6 +92,7 @@ class DisplayState:
 @dataclass
 class _Upload:
     path: str
+    rel_path: str  # as the client named it; echoed back in errors
     fobj: Any
     received: int = 0
     size: int = 0
@@ -209,7 +214,10 @@ class DataStreamingServer:
         elif verb == "CLIENT_FRAME_ACK":
             st = self._display_of(websocket)
             if st and msg.args:
-                st.bp.on_client_ack(int(msg.args[0]))
+                try:
+                    st.bp.on_client_ack(int(msg.args[0]))
+                except ValueError:
+                    pass
         elif verb == "r" and len(msg.args) >= 1:
             await self._on_resize(websocket, msg.args)
         elif verb == "START_VIDEO":
@@ -272,6 +280,14 @@ class DataStreamingServer:
         if t == 0x01:  # file chunk
             up = self._uploads.get(websocket)
             if up:
+                if up.size and up.received + len(data) - 1 > up.size:
+                    self._uploads.pop(websocket, None)
+                    up.fobj.close()
+                    os.unlink(up.path)
+                    await websocket.send(
+                        f"FILE_UPLOAD_ERROR:{up.rel_path}:"
+                        "exceeded declared size")
+                    return
                 up.fobj.write(data[1:])
                 up.received += len(data) - 1
         elif t == 0x02:  # microphone PCM
@@ -326,7 +342,6 @@ class DataStreamingServer:
         logger.info("client settings for %s: %s", display_id, applied)
 
         await self.reconfigure_display(st)
-        await self._reset_frame_ids_and_notify(st)
 
     async def _on_resize(self, websocket, args) -> None:
         if self.settings.is_manual_resolution_mode.value:
@@ -363,17 +378,34 @@ class DataStreamingServer:
     # capture / encode pipeline per display
 
     async def reconfigure_display(self, st: DisplayState) -> None:
-        await self._stop_display(st)
-        if st.video_active:
-            await self._start_display(st)
+        async with st.lock:
+            await self._stop_display_locked(st)
+            if st.video_active:
+                await self._start_display_locked(st)
 
     async def _start_display(self, st: DisplayState) -> None:
+        async with st.lock:
+            await self._start_display_locked(st)
+
+    async def _stop_display(self, st: DisplayState) -> None:
+        async with st.lock:
+            await self._stop_display_locked(st)
+
+    async def _start_display_locked(self, st: DisplayState) -> None:
         if st.capture_task and not st.capture_task.done():
             return
+        # A crashed capture loop may leave a live backpressure task behind;
+        # tear both down so restarts never leak a ticking loop.
+        await self._stop_display_locked(st)
+        # The capture loop numbers frames from 1 again, so the client and the
+        # backpressure gate must drop their old frame-id horizon — otherwise
+        # desync = (1 - old_ack) mod 2^16 reads as a huge lag and wedges the
+        # gate closed (reference resets likewise, selkies.py:1119-1146).
+        await self._reset_frame_ids_and_notify(st)
         st.capture_task = asyncio.create_task(self._capture_loop(st))
         st.backpressure_task = asyncio.create_task(self._backpressure_loop(st))
 
-    async def _stop_display(self, st: DisplayState) -> None:
+    async def _stop_display_locked(self, st: DisplayState) -> None:
         for attr in ("capture_task", "backpressure_task"):
             task = getattr(st, attr)
             if task and not task.done():
@@ -451,7 +483,12 @@ class DataStreamingServer:
         if "upload" not in self.settings.file_transfers:
             await websocket.send("FILE_UPLOAD_ERROR:GENERAL:uploads disabled")
             return
-        rel_path, size = args[0], int(args[1] or 0)
+        try:
+            rel_path = args[0]
+            size = int(args[1]) if len(args) > 1 and args[1] else 0
+        except (ValueError, IndexError):
+            await websocket.send("FILE_UPLOAD_ERROR:GENERAL:bad upload header")
+            return
         root = os.path.realpath(self._upload_dir())
         norm = os.path.normpath(rel_path)
         if norm.startswith(("/", "\\")) or ".." in norm.split(os.sep):
@@ -466,7 +503,7 @@ class DataStreamingServer:
         if old:
             old.fobj.close()
         self._uploads[websocket] = _Upload(
-            path=target, fobj=open(target, "wb"), size=size)
+            path=target, rel_path=rel_path, fobj=open(target, "wb"), size=size)
         logger.info("upload started: %s (%d bytes)", target, size)
 
     # ------------------------------------------------------------------

@@ -194,7 +194,7 @@ async def test_file_upload_and_path_traversal(tmp_path):
     try:
         async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
             await handshake(ws)
-            await ws.send("FILE_UPLOAD_START:sub/ok.txt:9")
+            await ws.send("FILE_UPLOAD_START:sub/ok.txt:11")
             await ws.send(b"\x01hello")
             await ws.send(b"\x01 world")
             await ws.send("FILE_UPLOAD_END:sub/ok.txt")
@@ -303,6 +303,59 @@ async def test_settings_overrides_reach_encoder_factory(tmp_path):
             assert seen.get("jpeg_quality") == 77
             st = server.display_clients["primary"]
             assert st.bp.framerate == 24.0
+    finally:
+        await server.stop()
+        srv.close()
+
+
+@pytest.mark.anyio
+async def test_upload_exceeding_declared_size_rejected(tmp_path):
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await handshake(ws)
+            await ws.send("FILE_UPLOAD_START:big.bin:4")
+            await ws.send(b"\x01" + b"x" * 100)
+            msg = await asyncio.wait_for(ws.recv(), 5)
+            assert msg.startswith("FILE_UPLOAD_ERROR")
+            assert not (tmp_path / "uploads" / "big.bin").exists()
+            # further chunks are ignored, session stays alive
+            await ws.send(b"\x01more")
+            await ws.send("r,bogus")  # malformed resize is tolerated too
+            await ws.send("CLIENT_FRAME_ACK notanint")
+            pong = await ws.ping()
+            await asyncio.wait_for(pong, 5)  # socket still open, not torn down
+    finally:
+        await server.stop()
+        srv.close()
+
+
+@pytest.mark.anyio
+async def test_resize_resets_frame_ids(tmp_path):
+    """A capture restart renumbers frames from 1, so the server must emit
+    PIPELINE_RESETTING (else the backpressure gate wedges on stale ACKs)."""
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await handshake(ws)
+            await ws.send('SETTINGS,{"displayId": "primary"}')
+            await asyncio.wait_for(ws.recv(), 5)
+            st = server.display_clients["primary"]
+            st.bp.on_frame_sent(40000)
+            st.bp.on_client_ack(40000)
+            await ws.send("r,1280x720,primary")
+            saw_reset = False
+            for _ in range(20):
+                m = await asyncio.wait_for(ws.recv(), 5)
+                if isinstance(m, str) and m.startswith("PIPELINE_RESETTING"):
+                    saw_reset = True
+                    break
+            assert saw_reset
+            # restarted loop renumbers from 1 — the stale 40000 horizon is gone
+            assert st.bp.last_sent_frame_id < 100
+            assert st.bp.send_enabled
     finally:
         await server.stop()
         srv.close()
