@@ -255,9 +255,9 @@ class DataStreamingServer:
         elif verb == "cmd":
             if self.settings.command_enabled.value and msg.args:
                 await self._run_command(msg.args[0])
-        elif verb in ("kd", "ku", "kr", "m", "m2", "js", "cw", "cb", "cr",
-                      "cws", "cwd", "cwe", "cbs", "cbd", "cbe", "_f", "_l",
-                      "SET_NATIVE_CURSOR_RENDERING", "s"):
+        else:
+            # Everything else is input-plane grammar; forward whole messages
+            # like the reference ws_handler does for non-prefixed text.
             if verb == "_f":
                 st = self._display_of(websocket)
                 if st and msg.args:
@@ -266,9 +266,10 @@ class DataStreamingServer:
                     except ValueError:
                         pass
             if self.input_handler is not None:
-                await self.input_handler.on_message(message, self._display_id_of(websocket))
-        else:
-            logger.debug("unhandled message verb %r", verb)
+                await self.input_handler.on_message(
+                    message, self._display_id_of(websocket))
+            else:
+                logger.debug("unhandled message verb %r", verb)
 
     # ------------------------------------------------------------------
     # binary protocol (client → server)
@@ -280,13 +281,18 @@ class DataStreamingServer:
         if t == 0x01:  # file chunk
             up = self._uploads.get(websocket)
             if up:
-                if up.size and up.received + len(data) - 1 > up.size:
+                # Absolute cap holds even when the client declares size 0
+                # (or lies): declared size is a courtesy check, the cap is
+                # the actual hardening.
+                cap = self.settings.max_upload_mb * 1024 * 1024
+                limit = min(up.size, cap) if up.size else cap
+                if limit and up.received + len(data) - 1 > limit:
                     self._uploads.pop(websocket, None)
                     up.fobj.close()
                     os.unlink(up.path)
                     await websocket.send(
                         f"FILE_UPLOAD_ERROR:{up.rel_path}:"
-                        "exceeded declared size")
+                        "exceeded size limit")
                     return
                 up.fobj.write(data[1:])
                 up.received += len(data) - 1
@@ -469,6 +475,18 @@ class DataStreamingServer:
         while True:
             await asyncio.sleep(CHECK_INTERVAL_S)
             st.bp.evaluate()
+
+    async def set_framerate(self, fps: float) -> None:
+        """Apply a new target framerate to every active display.
+
+        Wire-level parity with the reference ``_arg_fps`` path
+        (input_handler.py:1662 → app.set_fps → pipeline restart).
+        """
+        fps = float(self.settings.framerate.clamp(int(fps)))
+        for st in list(self.display_clients.values()):
+            st.bp.framerate = fps
+            if st.capture_task is not None and not st.capture_task.done():
+                await self.reconfigure_display(st)
 
     # ------------------------------------------------------------------
     # file upload (path-sanitized, reference selkies.py:1843-1952)

@@ -24,21 +24,47 @@ async def _amain(settings: Settings) -> int:
     app.data_server = server
 
     input_handler = None
+    cursor_monitor = None
     try:
-        from ..inputs.handler import InputHandler
+        from ..input import InputHandler, open_clipboard_backend, open_x11_backend
+        from ..input.cursor import CursorMonitor, open_cursor_source
 
-        input_handler = InputHandler(app=app, settings=settings)
+        input_handler = InputHandler(
+            backend=open_x11_backend(),
+            clipboard=open_clipboard_backend(),
+            data_server=server,
+            enable_clipboard=(
+                "true" if settings.clipboard_enabled.value else "false"),
+            enable_binary_clipboard=settings.enable_binary_clipboard.value,
+        )
+        def _on_set_fps(fps: int) -> None:
+            app.set_framerate(fps)
+            asyncio.get_running_loop().create_task(server.set_framerate(fps))
+
+        input_handler.on_set_fps = _on_set_fps
         server.input_handler = input_handler
+        cursor_monitor = CursorMonitor(open_cursor_source(), app.send_cursor)
     except Exception as e:  # no X display etc. — stream-only mode
         logging.getLogger("selkies_tpu").warning("input plane disabled: %s", e)
 
     tasks = [asyncio.create_task(server.run_server())]
     if input_handler is not None:
-        tasks.extend(input_handler.start_tasks())
+        tasks.append(asyncio.create_task(input_handler.run_clipboard_poll()))
+    if cursor_monitor is not None:
+        tasks.append(asyncio.create_task(cursor_monitor.run()))
     try:
         await asyncio.gather(*tasks)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        if cursor_monitor is not None:
+            cursor_monitor.stop()
+            cursor_monitor.source.close()
+        if input_handler is not None:
+            try:
+                await input_handler.close()
+            except Exception:
+                logging.getLogger("selkies_tpu").exception(
+                    "input plane shutdown failed")
         await server.stop()
     return 0

@@ -263,6 +263,9 @@ SETTING_DEFINITIONS: List[Spec] = [
     IntSpec("watermark_location", -1, "Watermark location enum (0-6).",
             legacy_env="WATERMARK_LOCATION"),
     BoolSpec("debug", False, "Debug logging.", server_only=True),
+    IntSpec("max_upload_mb", 4096, "Absolute per-file upload cap in MiB "
+            "(enforced regardless of the client-declared size).",
+            server_only=True),
 
     # Sharing
     BoolSpec("enable_sharing", True, "Master sharing toggle."),
