@@ -1,0 +1,340 @@
+"""tpuenc v1: H.264 Constrained-Baseline striped encoder.
+
+Capability parity with the reference's ``x264enc-striped`` / ``x264enc``
+pixelflux modes (CaptureSettings output_mode=1, selkies.py:2919-2963;
+client decoders selkies-core.js:2925-2968): each horizontal stripe is an
+independent H.264 video sequence with its own SPS/PPS/IDR chain, so the
+client can run one WebCodecs ``VideoDecoder`` per stripe and only damaged
+stripes are ever encoded or shipped.
+
+Split of work (TPU-first, SURVEY.md §7 step 6):
+  * device (encoder/h264_device.py): color/4:2:0, exhaustive ME, transforms,
+    quant, and the exact decoder-arithmetic reconstruction loop;
+  * host (native/cavlc.cpp): CAVLC entropy coding + NAL packaging of the
+    device's level arrays;
+  * here: stripe/GOP orchestration, damage gating, paint-over escalation
+    (low-QP P frames — no IDR needed, unlike the reference's burst
+    keyframes), SPS/PPS generation, reference-plane state.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..native import cavlc_lib
+from . import h264_device as dev
+
+logger = logging.getLogger("selkies_tpu.encoder.h264")
+
+MB = 16
+
+
+# ---------------------------------------------------------------------------
+# SPS / PPS
+
+
+class _BitWriter:
+    def __init__(self) -> None:
+        self.bits: List[int] = []
+
+    def u(self, value: int, n: int) -> None:
+        for i in range(n - 1, -1, -1):
+            self.bits.append((value >> i) & 1)
+
+    def ue(self, v: int) -> None:
+        vp1 = v + 1
+        n = vp1.bit_length() - 1
+        self.u(0, n)
+        self.u(vp1, n + 1)
+
+    def se(self, v: int) -> None:
+        self.ue(-2 * v if v <= 0 else 2 * v - 1)
+
+    def rbsp(self) -> bytes:
+        bits = self.bits + [1]
+        while len(bits) % 8:
+            bits.append(0)
+        out = bytearray()
+        for i in range(0, len(bits), 8):
+            b = 0
+            for bit in bits[i:i + 8]:
+                b = (b << 1) | bit
+            out.append(b)
+        # emulation prevention
+        esc = bytearray()
+        zeros = 0
+        for b in out:
+            if zeros >= 2 and b <= 3:
+                esc.append(3)
+                zeros = 0
+            esc.append(b)
+            zeros = zeros + 1 if b == 0 else 0
+        return bytes(esc)
+
+
+def _nal(nal_type: int, rbsp: bytes, ref_idc: int = 3) -> bytes:
+    return b"\x00\x00\x00\x01" + bytes(((ref_idc << 5) | nal_type,)) + rbsp
+
+
+def make_sps(width: int, height: int, *, level_idc: int = 40,
+             full_range: bool = True) -> bytes:
+    """Constrained-Baseline SPS for a (possibly cropped) 4:2:0 frame."""
+    mb_w = (width + 15) // 16
+    mb_h = (height + 15) // 16
+    crop_r = (mb_w * 16 - width) // 2
+    crop_b = (mb_h * 16 - height) // 2
+    bw = _BitWriter()
+    bw.u(66, 8)          # profile_idc: Baseline
+    bw.u(0b11000000, 8)  # constraint_set0+1 (constrained baseline)
+    bw.u(level_idc, 8)
+    bw.ue(0)             # sps id
+    bw.ue(0)             # log2_max_frame_num_minus4 → 4-bit frame_num
+    bw.ue(2)             # pic_order_cnt_type
+    bw.ue(1)             # max_num_ref_frames
+    bw.u(0, 1)           # gaps_in_frame_num_value_allowed
+    bw.ue(mb_w - 1)
+    bw.ue(mb_h - 1)
+    bw.u(1, 1)           # frame_mbs_only
+    bw.u(1, 1)           # direct_8x8_inference
+    if crop_r or crop_b:
+        bw.u(1, 1)
+        bw.ue(0)
+        bw.ue(crop_r)
+        bw.ue(0)
+        bw.ue(crop_b)
+    else:
+        bw.u(0, 1)
+    # VUI: declare BT.601 + range so the browser matches our color matrix
+    bw.u(1, 1)           # vui_parameters_present
+    bw.u(0, 1)           # aspect_ratio_info_present
+    bw.u(0, 1)           # overscan_info_present
+    bw.u(1, 1)           # video_signal_type_present
+    bw.u(5, 3)           # video_format: unspecified
+    bw.u(1 if full_range else 0, 1)
+    bw.u(1, 1)           # colour_description_present
+    bw.u(6, 8)           # primaries: SMPTE 170M
+    bw.u(6, 8)           # transfer
+    bw.u(6, 8)           # matrix: BT.601
+    bw.u(0, 1)           # chroma_loc_info_present
+    bw.u(0, 1)           # timing_info_present
+    bw.u(0, 1)           # nal_hrd
+    bw.u(0, 1)           # vcl_hrd
+    bw.u(0, 1)           # pic_struct_present
+    bw.u(0, 1)           # bitstream_restriction
+    return _nal(7, bw.rbsp())
+
+
+def make_pps() -> bytes:
+    bw = _BitWriter()
+    bw.ue(0)     # pps id
+    bw.ue(0)     # sps id
+    bw.u(0, 1)   # entropy_coding_mode: CAVLC
+    bw.u(0, 1)   # bottom_field_pic_order_in_frame_present
+    bw.ue(0)     # num_slice_groups_minus1
+    bw.ue(0)     # num_ref_idx_l0_default_active_minus1
+    bw.ue(0)     # num_ref_idx_l1_default_active_minus1
+    bw.u(0, 1)   # weighted_pred
+    bw.u(0, 2)   # weighted_bipred_idc
+    bw.se(0)     # pic_init_qp_minus26 (slice writer assumes 26)
+    bw.se(0)     # pic_init_qs_minus26
+    bw.se(0)     # chroma_qp_index_offset (qpc_for assumes 0)
+    bw.u(1, 1)   # deblocking_filter_control_present (slices disable it)
+    bw.u(0, 1)   # constrained_intra_pred
+    bw.u(0, 1)   # redundant_pic_cnt_present
+    return _nal(8, bw.rbsp())
+
+
+# ---------------------------------------------------------------------------
+# host entropy dispatch
+
+
+def encode_picture_nals(out: dev.StripeEncodeOut, *, is_idr: bool,
+                        mb_w: int, mb_h: int, qp: int, frame_num: int,
+                        idr_pic_id: int = 0) -> bytes:
+    """Run the native CAVLC coder over one stripe's device outputs."""
+    lib = cavlc_lib()
+    if lib is None:
+        raise RuntimeError("native CAVLC coder unavailable")
+    mv = np.ascontiguousarray(np.asarray(out.mv), np.int32)
+    luma = np.ascontiguousarray(np.asarray(out.luma), np.int32)
+    luma_dc = np.ascontiguousarray(np.asarray(out.luma_dc), np.int32)
+    chroma_dc = np.ascontiguousarray(np.asarray(out.chroma_dc), np.int32)
+    chroma_ac = np.ascontiguousarray(np.asarray(out.chroma_ac), np.int32)
+    cap = 1 << 22
+    buf = np.empty(cap, np.uint8)
+    n = lib.h264_encode_picture(
+        1 if is_idr else 0, mb_w, mb_h, qp, frame_num & 0xF, idr_pic_id,
+        mv, luma, luma_dc, chroma_dc, chroma_ac, buf, cap)
+    if n < 0:
+        raise RuntimeError("CAVLC output exceeded capacity")
+    return bytes(buf[:n])
+
+
+# ---------------------------------------------------------------------------
+# stripe orchestration
+
+
+@dataclass
+class H264Stripe:
+    y_start: int
+    width: int          # coded (cropped) width
+    height: int         # coded (cropped) height of this stripe
+    annexb: bytes
+    is_key: bool
+
+
+@dataclass
+class _StripeState:
+    y0: int             # luma row offset (unpadded coordinates)
+    h: int              # unpadded stripe height
+    pad_h: int          # MB-aligned height
+    frame_num: int = 0
+    idr_pic_id: int = 0
+    need_idr: bool = True
+    static_frames: int = 0
+    painted_over: bool = False
+    ref_y: Optional[jnp.ndarray] = None
+    ref_cb: Optional[jnp.ndarray] = None
+    ref_cr: Optional[jnp.ndarray] = None
+
+
+class H264StripeEncoder:
+    """Striped (or full-frame) H.264 encoder with damage gating.
+
+    ``fullframe=True`` reproduces the reference's ``x264enc`` mode: one
+    stripe covering the whole frame, shipped as 0x04 frames of full height
+    (the reference does the same — fullframe is striped mode with one
+    stripe, selkies.py:2937 h264_fullframe).
+    """
+
+    def __init__(self, width: int, height: int, *, stripe_height: int = 64,
+                 qp: int = 26, paint_over_qp: int = 18,
+                 paint_over_trigger_frames: int = 15,
+                 search: int = 12, fullframe: bool = False) -> None:
+        if width % 2 or height % 2:
+            raise ValueError("frame dimensions must be even")
+        if stripe_height % MB:
+            raise ValueError("stripe_height must be a multiple of 16")
+        self.width = width
+        self.height = height
+        self.qp = int(np.clip(qp, 0, 51))
+        self.paint_over_qp = int(np.clip(paint_over_qp, 0, 51))
+        self.paint_over_trigger = paint_over_trigger_frames
+        self.search = search
+        self.pad_w = (width + MB - 1) // MB * MB
+        sh = height if fullframe else stripe_height
+        self.stripe_h = sh
+        self.stripes: List[_StripeState] = []
+        y = 0
+        while y < height:
+            h = min(sh, height - y)
+            self.stripes.append(_StripeState(
+                y0=y, h=h, pad_h=(h + MB - 1) // MB * MB))
+            y += h
+        self._sps_pps: Dict[int, bytes] = {}
+        self._prev_rgb: Optional[jnp.ndarray] = None
+
+    # -- helpers -----------------------------------------------------------
+
+    def _sps_pps_for(self, st: _StripeState) -> bytes:
+        key = st.h
+        if key not in self._sps_pps:
+            self._sps_pps[key] = (make_sps(self.width, st.h) + make_pps())
+        return self._sps_pps[key]
+
+    def _damage_flags(self, rgb: jnp.ndarray) -> np.ndarray:
+        if self._prev_rgb is None:
+            return np.ones(len(self.stripes), bool)
+        flags = _stripe_damage(rgb, self._prev_rgb,
+                               tuple(s.y0 for s in self.stripes),
+                               tuple(s.h for s in self.stripes))
+        return np.asarray(flags)
+
+    # -- encode ------------------------------------------------------------
+
+    def encode_frame(self, rgb) -> List[H264Stripe]:
+        """RGB (H, W, 3) uint8 → encoded stripes (only damaged/paint-over)."""
+        rgb = jnp.asarray(rgb)
+        damage = self._damage_flags(rgb)
+        self._prev_rgb = rgb
+
+        y_full, cb_full, cr_full = dev.prepare_planes(
+            rgb, self.height, self.pad_w)
+
+        out: List[H264Stripe] = []
+        for i, st in enumerate(self.stripes):
+            paint_over = False
+            if not damage[i] and not st.need_idr:
+                st.static_frames += 1
+                if (st.static_frames >= self.paint_over_trigger
+                        and not st.painted_over):
+                    paint_over = True
+                    st.painted_over = True
+                else:
+                    continue
+            else:
+                st.static_frames = 0
+                st.painted_over = False
+
+            sy = _pad_stripe(y_full, st.y0, st.h, st.pad_h)
+            scb = _pad_stripe(cb_full, st.y0 // 2, st.h // 2, st.pad_h // 2)
+            scr = _pad_stripe(cr_full, st.y0 // 2, st.h // 2, st.pad_h // 2)
+
+            qp = self.paint_over_qp if paint_over else self.qp
+            mb_w = self.pad_w // MB
+            mb_h = st.pad_h // MB
+            if st.need_idr or st.ref_y is None:
+                enc = dev.encode_stripe_idr(sy, scb, scr, qp)
+                nals = encode_picture_nals(
+                    enc, is_idr=True, mb_w=mb_w, mb_h=mb_h, qp=qp,
+                    frame_num=0, idr_pic_id=st.idr_pic_id)
+                payload = self._sps_pps_for(st) + nals
+                st.frame_num = 1
+                st.idr_pic_id = (st.idr_pic_id + 1) % 16
+                st.need_idr = False
+                is_key = True
+            else:
+                enc = dev.encode_stripe_p(
+                    sy, scb, scr, st.ref_y, st.ref_cb, st.ref_cr, qp,
+                    self.search)
+                payload = encode_picture_nals(
+                    enc, is_idr=False, mb_w=mb_w, mb_h=mb_h, qp=qp,
+                    frame_num=st.frame_num)
+                st.frame_num = (st.frame_num + 1) % 16
+                is_key = False
+            st.ref_y, st.ref_cb, st.ref_cr = (
+                enc.recon_y, enc.recon_cb, enc.recon_cr)
+            out.append(H264Stripe(
+                y_start=st.y0, width=self.width, height=st.h,
+                annexb=payload, is_key=is_key))
+        return out
+
+    def request_keyframe(self) -> None:
+        """Force IDR on every stripe (client join / PIPELINE_RESETTING)."""
+        for st in self.stripes:
+            st.need_idr = True
+
+
+@functools.partial(jax.jit, static_argnames=("y0s", "hs"))
+def _stripe_damage(rgb, prev, y0s, hs):
+    flags = []
+    for y0, h in zip(y0s, hs):
+        a = jax.lax.dynamic_slice_in_dim(rgb, y0, h, axis=0)
+        b = jax.lax.dynamic_slice_in_dim(prev, y0, h, axis=0)
+        flags.append(jnp.any(a != b))
+    return jnp.stack(flags)
+
+
+@functools.partial(jax.jit, static_argnames=("y0", "h", "pad_h"))
+def _pad_stripe(plane, y0: int, h: int, pad_h: int):
+    s = jax.lax.dynamic_slice_in_dim(plane, y0, h, axis=0)
+    if pad_h != h:
+        s = jnp.pad(s, ((0, pad_h - h), (0, 0)), mode="edge")
+    return s

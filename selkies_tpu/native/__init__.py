@@ -27,17 +27,18 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _compile() -> bool:
-    cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-o", _SO, _SRC,
-    ]
+def _compile_lib(src: str, so: str) -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", so, src]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
     except (subprocess.SubprocessError, FileNotFoundError) as e:
-        logger.warning("native entropy coder build failed (%s); using Python fallback", e)
+        logger.warning("native build of %s failed (%s)", src, e)
         return False
+
+
+def _compile() -> bool:
+    return _compile_lib(_SRC, _SO)
 
 
 def entropy_lib() -> Optional[ctypes.CDLL]:
@@ -70,3 +71,43 @@ def entropy_lib() -> Optional[ctypes.CDLL]:
             fn.restype = ctypes.c_int64
         _lib = lib
         return _lib
+
+
+# ---------------------------------------------------------------------------
+# CAVLC slice coder (H.264 tpuenc v1)
+
+_CAVLC_SRC = os.path.join(_DIR, "cavlc.cpp")
+_CAVLC_SO = os.path.join(_DIR, "_libselkies_cavlc.so")
+_cavlc_lock = threading.Lock()
+_cavlc_lib: Optional[ctypes.CDLL] = None
+_cavlc_tried = False
+
+
+def cavlc_lib() -> Optional[ctypes.CDLL]:
+    """The compiled H.264 CAVLC slice coder, or None if unavailable."""
+    global _cavlc_lib, _cavlc_tried
+    with _cavlc_lock:
+        if _cavlc_lib is not None or _cavlc_tried:
+            return _cavlc_lib
+        _cavlc_tried = True
+        stale = (not os.path.exists(_CAVLC_SO)
+                 or os.path.getmtime(_CAVLC_SO) < os.path.getmtime(_CAVLC_SRC))
+        if stale and not _compile_lib(_CAVLC_SRC, _CAVLC_SO):
+            return None
+        try:
+            lib = ctypes.CDLL(_CAVLC_SO)
+        except OSError as e:
+            logger.warning("cavlc coder load failed: %s", e)
+            return None
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        fn = lib.h264_encode_picture
+        fn.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            i32p, i32p, i32p, i32p, i32p,
+            u8p, ctypes.c_int64,
+        ]
+        fn.restype = ctypes.c_int64
+        _cavlc_lib = lib
+        return _cavlc_lib

@@ -1,0 +1,149 @@
+"""H.264 transform/quant/motion op tests against independent numpy mirrors."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.ops import h264_transform as ht
+from selkies_tpu.ops.motion import (NumpyMotionMirror, full_search_mv,
+                                    mc_chroma, mc_luma)
+
+RNG = np.random.default_rng(42)
+
+
+# ---------------------------------------------------------------------------
+# transforms
+
+
+def test_forward_inverse_roundtrip_lossless_at_qp0():
+    """At QP 0 (and low magnitudes) quant→dequant→idct must invert the
+    forward path to within the known H.264 reconstruction envelope."""
+    x = RNG.integers(-255, 256, (64, 4, 4)).astype(np.int32)
+    w = np.asarray(ht.forward_dct4(x))
+    for qp in (0, 10, 24, 38, 51):
+        z = np.asarray(ht.quant4(w, qp, intra=True))
+        d = np.asarray(ht.dequant4(z, qp))
+        r = np.asarray(ht.inverse_dct4(d))
+        qstep = 0.625 * 2 ** (qp / 6)
+        # measured envelope ≈1.3-1.6×Qstep (intra deadzone + basis norms)
+        assert np.abs(r - x).max() <= qstep * 2 + 2, qp
+
+
+def test_inverse_dct_matches_numpy_mirror():
+    d = RNG.integers(-2000, 2000, (128, 4, 4)).astype(np.int32)
+    ours = np.asarray(ht.inverse_dct4(d))
+    mirror = ht.NumpyMirror.inverse_dct4(d)
+    np.testing.assert_array_equal(ours, mirror)
+
+
+def test_dequant_matches_mirror():
+    z = RNG.integers(-100, 100, (32, 4, 4)).astype(np.int32)
+    for qp in (0, 7, 23, 36, 51):
+        np.testing.assert_array_equal(
+            np.asarray(ht.dequant4(z, qp)), ht.NumpyMirror.dequant4(z, qp))
+        np.testing.assert_array_equal(
+            np.asarray(ht.dequant_dc16(z, qp)),
+            ht.NumpyMirror.dequant_dc16(z, qp))
+    for qpc in (0, 17, 29, 39):
+        z2 = RNG.integers(-100, 100, (32, 2, 2)).astype(np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(ht.dequant_dc2(z2, qpc)),
+            ht.NumpyMirror.dequant_dc2(z2, qpc))
+
+
+def test_dc16_roundtrip():
+    """I16 DC path: decoder output must land in the AC dequant domain,
+    i.e. rec ≈ 4·dc (d = 4·W consistency), within a Qstep-scaled bound."""
+    dc = RNG.integers(-4080, 4080, (16, 4, 4)).astype(np.int32)
+    for qp in (0, 20, 36, 44):
+        y = np.asarray(ht.hadamard4_fwd(dc))
+        z = np.asarray(ht.quant_dc16(y, qp))
+        rec = np.asarray(ht.dequant_dc16(z, qp))
+        qstep = 0.625 * 2 ** (qp / 6)
+        err = np.abs(rec / 4.0 - dc)
+        # inverse Hadamard spreads per-level error ×4 (in units of 4·W)
+        assert err.max() <= qstep * 4 + 4, (qp, err.max())
+
+
+def test_dc2_roundtrip():
+    dc = RNG.integers(-4080, 4080, (16, 2, 2)).astype(np.int32)
+    for qp in (0, 20, 39):
+        y = np.asarray(ht.hadamard2_fwd(dc))
+        z = np.asarray(ht.quant_dc2(y, qp))
+        rec = np.asarray(ht.dequant_dc2(z, qp))
+        qstep = 0.625 * 2 ** (qp / 6)
+        err = np.abs(rec / 4.0 - dc)
+        assert err.max() <= qstep * 4 + 4, (qp, err.max())
+
+
+def test_qpc_table():
+    assert ht.qpc_for(20) == 20
+    assert ht.qpc_for(30) == 29
+    assert ht.qpc_for(40) == 36
+    assert ht.qpc_for(51) == 39
+
+
+def test_block_layout_roundtrip():
+    import jax.numpy as jnp
+    p = jnp.asarray(RNG.integers(0, 255, (16, 32)))
+    b = ht.plane_to_blocks(p)
+    assert b.shape == (4, 8, 4, 4)
+    np.testing.assert_array_equal(np.asarray(ht.blocks_to_plane(b)),
+                                  np.asarray(p))
+
+
+# ---------------------------------------------------------------------------
+# motion
+
+
+def test_full_search_finds_translation():
+    h, w = 64, 128
+    ref = RNG.integers(0, 256, (h, w)).astype(np.uint8)
+    # shift content by (3, -5): cur[y, x] = ref[y-3, x+5]
+    cur = np.roll(np.roll(ref, 3, axis=0), -5, axis=1)
+    mv, sad0, best = full_search_mv(cur, ref, search=8)
+    mv = np.asarray(mv)
+    # interior MBs must find exactly (-3, +5)... mv points from cur into ref
+    inner = mv[1:-1, 1:-1]
+    assert (inner[..., 0] == -3).all() and (inner[..., 1] == 5).all()
+    assert np.asarray(best)[1:-1, 1:-1].max() == 0
+
+
+def test_full_search_zero_bias_on_flat():
+    flat = np.full((32, 32), 77, np.uint8)
+    mv, sad0, best = full_search_mv(flat, flat, search=4)
+    assert (np.asarray(mv) == 0).all()   # ties must resolve to (0,0)
+
+
+def test_mc_luma_matches_mirror():
+    h, w = 32, 48
+    ref = RNG.integers(0, 256, (h, w)).astype(np.uint8)
+    mv = RNG.integers(-6, 7, (h // 16, w // 16, 2)).astype(np.int32)
+    ours = np.asarray(mc_luma(ref, mv, search=8))
+    mirror = NumpyMotionMirror.mc_luma(ref, mv)
+    np.testing.assert_array_equal(ours, mirror)
+
+
+def test_mc_luma_edge_extension():
+    """MVs pointing outside the plane must clamp like the decoder."""
+    ref = np.arange(32 * 32, dtype=np.uint8).reshape(32, 32)
+    mv = np.full((2, 2, 2), -8, np.int32)   # everything points up-left
+    ours = np.asarray(mc_luma(ref, mv, search=8))
+    mirror = NumpyMotionMirror.mc_luma(ref, mv)
+    np.testing.assert_array_equal(ours, mirror)
+
+
+def test_mc_chroma_halfpel_matches_mirror():
+    hc, wc = 16, 24
+    ref_c = RNG.integers(0, 256, (hc, wc)).astype(np.uint8)
+    # odd MVs exercise the half-pel bilinear path
+    mv = RNG.integers(-5, 6, (hc // 8, wc // 8, 2)).astype(np.int32)
+    ours = np.asarray(mc_chroma(ref_c, mv, search=8))
+    mirror = NumpyMotionMirror.mc_chroma(ref_c, mv)
+    np.testing.assert_array_equal(ours, mirror)
+
+
+def test_batched_search_over_stripes():
+    stripes = RNG.integers(0, 256, (3, 32, 64)).astype(np.uint8)
+    mv, sad0, best = full_search_mv(stripes, stripes, search=4)
+    assert np.asarray(mv).shape == (3, 2, 4, 2)
+    assert (np.asarray(best) == 0).all()

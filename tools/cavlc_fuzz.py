@@ -1,0 +1,116 @@
+"""CAVLC conformance fuzzer: crafted level arrays → C++ coder → ffmpeg.
+
+Drives h264_encode_picture with synthetic quantized-level arrays (bypassing
+the device transforms) so every (totalCoeff, trailingOnes, nC-class,
+total_zeros, run_before) table entry gets exercised, then decodes with
+OpenCV/ffmpeg and compares against the NumpyMirror reconstruction.  Used to
+validate the hand-entered spec tables in native/cavlc.cpp; kept as a tool
+(tests run a bounded version).
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import cv2  # noqa: E402
+
+from selkies_tpu.encoder.h264 import make_pps, make_sps  # noqa: E402
+from selkies_tpu.native import cavlc_lib  # noqa: E402
+from selkies_tpu.ops.h264_transform import NumpyMirror  # noqa: E402
+
+
+def mirror_recon_luma(levels, qp, pred=128):
+    """Decoder-side luma recon for P-style plain 4×4 levels (n,16,4,4)."""
+    d = NumpyMirror.dequant4(levels, qp)
+    r = NumpyMirror.inverse_dct4(d)
+    return r + pred  # caller clips
+
+
+def assemble_plane(blocks, mb_w, mb_h):
+    """(n,16,4,4) → (H, W) with raster 4×4 grid inside raster MBs."""
+    n = mb_w * mb_h
+    v = blocks.reshape(mb_h, mb_w, 4, 4, 4, 4)
+    v = v.transpose(0, 2, 4, 1, 3, 5)
+    return v.reshape(mb_h * 16, mb_w * 16)
+
+
+def encode_two_frames(luma_levels, mb_w, mb_h, qp):
+    lib = cavlc_lib()
+    n = mb_w * mb_h
+    zero_mv = np.zeros((n, 2), np.int32)
+    zero_luma = np.zeros((n, 16, 16), np.int32)
+    zero_ldc = np.zeros((n, 16), np.int32)
+    zero_cdc = np.zeros((n, 2, 4), np.int32)
+    zero_cac = np.zeros((n, 2, 4, 16), np.int32)
+    cap = 1 << 22
+    buf = np.empty(cap, np.uint8)
+    # IDR: all-zero levels → flat 128
+    sz = lib.h264_encode_picture(1, mb_w, mb_h, qp, 0, 0, zero_mv, zero_luma,
+                                 zero_ldc, zero_cdc, zero_cac, buf, cap)
+    idr = bytes(buf[:sz])
+    ll = np.ascontiguousarray(luma_levels.reshape(n, 16, 16), np.int32)
+    sz = lib.h264_encode_picture(0, mb_w, mb_h, qp, 1, 0, zero_mv, ll,
+                                 zero_ldc, zero_cdc, zero_cac, buf, cap)
+    p = bytes(buf[:sz])
+    return make_sps(mb_w * 16, mb_h * 16) + make_pps() + idr + p
+
+
+def decode_stream(data):
+    path = tempfile.mktemp(suffix=".h264")
+    with open(path, "wb") as f:
+        f.write(data)
+    cap = cv2.VideoCapture(path)
+    cap.set(cv2.CAP_PROP_CONVERT_RGB, 0)
+    frames = []
+    while True:
+        ok, y = cap.read()
+        if not ok:
+            break
+        frames.append(y.copy())
+    os.unlink(path)
+    return frames
+
+
+def random_levels(rng, n_mb, density, magnitude):
+    lv = rng.integers(-magnitude, magnitude + 1, (n_mb, 16, 4, 4))
+    mask = rng.random((n_mb, 16, 4, 4)) < density
+    return (lv * mask).astype(np.int32)
+
+
+def check_seed(seed, qp=26, mb_w=2, mb_h=2, density=None, magnitude=None):
+    rng = np.random.default_rng(seed)
+    density = density if density is not None else rng.uniform(0.05, 0.9)
+    magnitude = magnitude if magnitude is not None else int(rng.integers(1, 9))
+    levels = random_levels(rng, mb_w * mb_h, density, magnitude)
+    stream = encode_two_frames(levels, mb_w, mb_h, qp)
+    frames = decode_stream(stream)
+    if len(frames) != 2:
+        return False, f"decoded {len(frames)} frames", levels
+    expect = np.clip(
+        mirror_recon_luma(levels, qp) .astype(np.int64), -10**9, 10**9)
+    expect = np.clip(assemble_plane(expect, mb_w, mb_h) , 0, 255)
+    got = frames[1].astype(np.int64)
+    if not np.array_equal(got, expect):
+        diff = int(np.abs(got - expect).max())
+        return False, f"pixel mismatch max {diff}", levels
+    return True, "", levels
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500
+    fails = []
+    for seed in range(n):
+        ok, why, _ = check_seed(seed)
+        if not ok:
+            fails.append((seed, why))
+            print(f"seed {seed}: FAIL ({why})")
+    print(f"{n - len(fails)}/{n} passed")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
