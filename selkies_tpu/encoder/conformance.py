@@ -1,0 +1,88 @@
+"""Bitstream conformance oracle: decode tpuenc output with libavcodec.
+
+Stands in for the browser's WebCodecs decoders (reference client
+selkies-core.js:2032 VideoDecoder, :2155 ImageDecoder, :2925-2968 per-stripe
+decoder pool): every byte we ship must decode cleanly there, and for H.264
+the decoder's pixels must be *bit-exact* with the encoder's reconstruction
+loop (both run the same §8.5 integer arithmetic).  Used by tests and debug
+tooling only.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..native import conformance_lib
+
+YuvFrame = Tuple[np.ndarray, np.ndarray, np.ndarray]
+
+
+class ConformanceDecoder:
+    """Stateful H.264 (or MJPEG) decoder over libavcodec.
+
+    ``codec`` is "h264" or "mjpeg".  ``max_dim`` bounds the plane buffers.
+    """
+
+    def __init__(self, codec: str = "h264", max_dim: int = 4096) -> None:
+        lib = conformance_lib()
+        if lib is None:
+            raise RuntimeError("conformance decoder unavailable")
+        self._lib = lib
+        ctor = lib.conf_h264_new if codec == "h264" else lib.conf_mjpeg_new
+        self._h = ctor()
+        if not self._h:
+            raise RuntimeError(f"could not open {codec} decoder")
+        self._y = np.empty(max_dim * max_dim, np.uint8)
+        self._u = np.empty((max_dim // 2) * (max_dim // 2), np.uint8)
+        self._v = np.empty_like(self._u)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.conf_dec_free(self._h)
+            self._h = None
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _take(self, w: int, h: int) -> YuvFrame:
+        cw, ch = (w + 1) // 2, (h + 1) // 2
+        y = self._y[:w * h].reshape(h, w).copy()
+        u = self._u[:cw * ch].reshape(ch, cw).copy()
+        v = self._v[:cw * ch].reshape(ch, cw).copy()
+        return y, u, v
+
+    def decode(self, data: bytes) -> Optional[YuvFrame]:
+        """Feed one access unit; return the decoded frame (or None)."""
+        w = ctypes.c_int()
+        h = ctypes.c_int()
+        buf = np.frombuffer(data, np.uint8)
+        n = self._lib.conf_dec_decode(
+            self._h, np.ascontiguousarray(buf), len(data),
+            self._y, self._u, self._v, self._y.size, self._u.size,
+            ctypes.byref(w), ctypes.byref(h))
+        if n < 0:
+            raise RuntimeError(f"decode error {n}")
+        if n == 0:
+            return None
+        return self._take(w.value, h.value)
+
+    def flush(self) -> List[YuvFrame]:
+        w = ctypes.c_int()
+        h = ctypes.c_int()
+        out: List[YuvFrame] = []
+        n = self._lib.conf_dec_flush(
+            self._h, self._y, self._u, self._v, self._y.size, self._u.size,
+            ctypes.byref(w), ctypes.byref(h))
+        if n > 0:
+            out.append(self._take(w.value, h.value))
+        return out
+
+
+def available() -> bool:
+    return conformance_lib() is not None

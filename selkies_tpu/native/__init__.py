@@ -27,14 +27,24 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _compile_lib(src: str, so: str) -> bool:
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", so, src]
+def _compile_lib(src: str, so: str, extra: tuple = ()) -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", so, src,
+           *extra]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
     except (subprocess.SubprocessError, FileNotFoundError) as e:
         logger.warning("native build of %s failed (%s)", src, e)
         return False
+
+
+def _stale(so: str, src: str) -> bool:
+    if not os.path.exists(so):
+        return True
+    try:
+        return os.path.getmtime(so) < os.path.getmtime(src)
+    except OSError:
+        return False  # source missing but .so present: use the .so
 
 
 def _compile() -> bool:
@@ -48,9 +58,7 @@ def entropy_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        stale = (not os.path.exists(_SO)
-                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
-        if stale and not _compile():
+        if _stale(_SO, _SRC) and not _compile():
             return None
         try:
             lib = ctypes.CDLL(_SO)
@@ -83,6 +91,49 @@ _cavlc_lib: Optional[ctypes.CDLL] = None
 _cavlc_tried = False
 
 
+_CONF_SRC = os.path.join(_DIR, "conformance.cpp")
+_CONF_SO = os.path.join(_DIR, "_libselkies_conformance.so")
+_conf_lock = threading.Lock()
+_conf_lib: Optional[ctypes.CDLL] = None
+_conf_tried = False
+
+
+def conformance_lib() -> Optional[ctypes.CDLL]:
+    """libavcodec-backed conformance decoder, or None if unavailable.
+
+    Test/debug oracle only (never on the hot path): decodes our Annex-B
+    H.264 and JFIF output with a production decoder, standing in for the
+    browser's WebCodecs decoders.
+    """
+    global _conf_lib, _conf_tried
+    with _conf_lock:
+        if _conf_lib is not None or _conf_tried:
+            return _conf_lib
+        _conf_tried = True
+        if _stale(_CONF_SO, _CONF_SRC) and not _compile_lib(
+                _CONF_SRC, _CONF_SO, ("-lavcodec", "-lavutil")):
+            return None
+        try:
+            lib = ctypes.CDLL(_CONF_SO)
+        except OSError as e:
+            logger.warning("conformance decoder load failed: %s", e)
+            return None
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i32p = ctypes.POINTER(ctypes.c_int)
+        lib.conf_h264_new.restype = ctypes.c_void_p
+        lib.conf_mjpeg_new.restype = ctypes.c_void_p
+        lib.conf_dec_free.argtypes = [ctypes.c_void_p]
+        caps = [ctypes.c_int64, ctypes.c_int64]
+        lib.conf_dec_decode.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int64,
+                                        u8p, u8p, u8p, *caps, i32p, i32p]
+        lib.conf_dec_decode.restype = ctypes.c_int
+        lib.conf_dec_flush.argtypes = [ctypes.c_void_p, u8p, u8p, u8p,
+                                       *caps, i32p, i32p]
+        lib.conf_dec_flush.restype = ctypes.c_int
+        _conf_lib = lib
+        return _conf_lib
+
+
 def cavlc_lib() -> Optional[ctypes.CDLL]:
     """The compiled H.264 CAVLC slice coder, or None if unavailable."""
     global _cavlc_lib, _cavlc_tried
@@ -90,9 +141,8 @@ def cavlc_lib() -> Optional[ctypes.CDLL]:
         if _cavlc_lib is not None or _cavlc_tried:
             return _cavlc_lib
         _cavlc_tried = True
-        stale = (not os.path.exists(_CAVLC_SO)
-                 or os.path.getmtime(_CAVLC_SO) < os.path.getmtime(_CAVLC_SRC))
-        if stale and not _compile_lib(_CAVLC_SRC, _CAVLC_SO):
+        if _stale(_CAVLC_SO, _CAVLC_SRC) and not _compile_lib(
+                _CAVLC_SRC, _CAVLC_SO):
             return None
         try:
             lib = ctypes.CDLL(_CAVLC_SO)

@@ -1,0 +1,143 @@
+// Conformance decoder for tpuenc bitstreams, backed by the system libavcodec.
+//
+// The browser's WebCodecs VideoDecoder/ImageDecoder are the real consumers of
+// the tpuenc H.264/JPEG output (reference client selkies-core.js:2032,2155,
+// 2925-2968); bitstream bugs there present as silent black canvases.  This
+// lib gives CI an equivalent oracle: decode our Annex-B / JFIF output with a
+// production decoder and compare the pixels against the encoder's own
+// reconstruction (H.264: must be bit-exact; JPEG: close to source).
+//
+// Built lazily by selkies_tpu.native.conformance_lib(); only used by tests
+// and debug tooling, never on the streaming hot path.
+
+extern "C" {
+#include <libavcodec/avcodec.h>
+#include <libavutil/imgutils.h>
+}
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct Dec {
+    const AVCodec *codec = nullptr;
+    AVCodecContext *ctx = nullptr;
+    AVFrame *frame = nullptr;
+    AVPacket *pkt = nullptr;
+};
+
+Dec *dec_new(AVCodecID id) {
+    const AVCodec *codec = avcodec_find_decoder(id);
+    if (!codec) return nullptr;
+    Dec *d = new Dec();
+    d->codec = codec;
+    d->ctx = avcodec_alloc_context3(codec);
+    if (!d->ctx) { delete d; return nullptr; }
+    // our streams have no reordering (poc type 2, no B-frames)
+    d->ctx->flags |= AV_CODEC_FLAG_LOW_DELAY;
+    if (avcodec_open2(d->ctx, codec, nullptr) < 0) {
+        avcodec_free_context(&d->ctx);
+        delete d;
+        return nullptr;
+    }
+    d->frame = av_frame_alloc();
+    d->pkt = av_packet_alloc();
+    return d;
+}
+
+void dec_free(Dec *d) {
+    if (!d) return;
+    if (d->pkt) av_packet_free(&d->pkt);
+    if (d->frame) av_frame_free(&d->frame);
+    if (d->ctx) avcodec_free_context(&d->ctx);
+    delete d;
+}
+
+// Copy one decoded frame's planes into tightly-packed caller buffers of
+// y_cap / c_cap bytes.  Returns 0 on success, -6 if the frame exceeds the
+// caller's capacity (never writes past it).
+int copy_planes(const AVFrame *f, uint8_t *y, uint8_t *u, uint8_t *v,
+                int64_t y_cap, int64_t c_cap, int *out_w, int *out_h) {
+    const int w = f->width, h = f->height;
+    *out_w = w;
+    *out_h = h;
+    const AVPixelFormat fmt = (AVPixelFormat)f->format;
+    if (fmt != AV_PIX_FMT_YUV420P && fmt != AV_PIX_FMT_YUVJ420P)
+        return -2;
+    if ((int64_t)w * h > y_cap
+        || (int64_t)((w + 1) / 2) * ((h + 1) / 2) > c_cap)
+        return -6;
+    for (int r = 0; r < h; ++r)
+        memcpy(y + (size_t)r * w, f->data[0] + (size_t)r * f->linesize[0], w);
+    const int cw = (w + 1) / 2, ch = (h + 1) / 2;
+    for (int r = 0; r < ch; ++r) {
+        memcpy(u + (size_t)r * cw, f->data[1] + (size_t)r * f->linesize[1], cw);
+        memcpy(v + (size_t)r * cw, f->data[2] + (size_t)r * f->linesize[2], cw);
+    }
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *conf_h264_new() { return dec_new(AV_CODEC_ID_H264); }
+void *conf_mjpeg_new() { return dec_new(AV_CODEC_ID_MJPEG); }
+
+void conf_dec_free(void *h) { dec_free((Dec *)h); }
+
+// Feed one access unit (or a whole SPS+PPS+slice chunk); returns the number
+// of frames decoded out (0 or 1 for our low-delay streams), negative on
+// error.  On 1, the planes are written into y/u/v and dims into out_w/out_h.
+int conf_dec_decode(void *h, const uint8_t *data, int64_t size,
+                    uint8_t *y, uint8_t *u, uint8_t *v,
+                    int64_t y_cap, int64_t c_cap,
+                    int *out_w, int *out_h) {
+    Dec *d = (Dec *)h;
+    if (!d) return -1;
+    // libavcodec requires input padding
+    uint8_t *buf = (uint8_t *)av_malloc(size + AV_INPUT_BUFFER_PADDING_SIZE);
+    if (!buf) return -1;
+    memcpy(buf, data, size);
+    memset(buf + size, 0, AV_INPUT_BUFFER_PADDING_SIZE);
+    av_packet_unref(d->pkt);
+    d->pkt->data = buf;
+    d->pkt->size = (int)size;
+    int rc = avcodec_send_packet(d->ctx, d->pkt);
+    d->pkt->data = nullptr;
+    d->pkt->size = 0;
+    av_free(buf);
+    if (rc < 0) return -3;
+    int got = 0;
+    while (true) {
+        rc = avcodec_receive_frame(d->ctx, d->frame);
+        if (rc == AVERROR(EAGAIN) || rc == AVERROR_EOF) break;
+        if (rc < 0) return -4;
+        int cp = copy_planes(d->frame, y, u, v, y_cap, c_cap, out_w, out_h);
+        if (cp != 0) return cp == -6 ? -6 : -5;
+        got += 1;
+    }
+    return got;
+}
+
+// Drain buffered frames at end of stream (harmless for low-delay streams).
+int conf_dec_flush(void *h, uint8_t *y, uint8_t *u, uint8_t *v,
+                   int64_t y_cap, int64_t c_cap,
+                   int *out_w, int *out_h) {
+    Dec *d = (Dec *)h;
+    if (!d) return -1;
+    if (avcodec_send_packet(d->ctx, nullptr) < 0) return -3;
+    int got = 0;
+    while (true) {
+        int rc = avcodec_receive_frame(d->ctx, d->frame);
+        if (rc == AVERROR(EAGAIN) || rc == AVERROR_EOF) break;
+        if (rc < 0) return -4;
+        int cp = copy_planes(d->frame, y, u, v, y_cap, c_cap, out_w, out_h);
+        if (cp != 0) return cp == -6 ? -6 : -5;
+        got += 1;
+    }
+    return got;
+}
+
+}  // extern "C"
