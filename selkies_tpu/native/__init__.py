@@ -1,6 +1,6 @@
 """Native (C++) runtime components, built lazily with the system toolchain.
 
-The build is a single ``g++ -O3 -shared`` invocation cached next to the
+Each lib is a single ``g++ -O3 -shared`` invocation cached next to the
 sources; if no toolchain is available the callers fall back to the
 pure-Python implementations (slower but correct).
 """
@@ -12,19 +12,13 @@ import logging
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "entropy.cpp")
-_SO = os.path.join(_DIR, "_libselkies_entropy.so")
-
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
 
 
 def _compile_lib(src: str, so: str, extra: tuple = ()) -> bool:
@@ -47,55 +41,121 @@ def _stale(so: str, src: str) -> bool:
         return False  # source missing but .so present: use the .so
 
 
-def _compile() -> bool:
-    return _compile_lib(_SRC, _SO)
+class _LazyLib:
+    """Build-once/load-once holder for one native lib."""
+
+    def __init__(self, name: str, extra: tuple = (),
+                 register: Optional[Callable] = None) -> None:
+        self.src = os.path.join(_DIR, name + ".cpp")
+        self.so = os.path.join(_DIR, f"_libselkies_{name}.so")
+        self.extra = extra
+        self.register = register
+        self._lock = threading.Lock()
+        self._lib: Optional[ctypes.CDLL] = None
+        self._tried = False
+
+    def get(self) -> Optional[ctypes.CDLL]:
+        with self._lock:
+            if self._lib is not None or self._tried:
+                return self._lib
+            self._tried = True
+            if _stale(self.so, self.src) and not _compile_lib(
+                    self.src, self.so, self.extra):
+                return None
+            try:
+                lib = ctypes.CDLL(self.so)
+            except OSError as e:
+                logger.warning("native lib %s load failed: %s", self.so, e)
+                return None
+            if self.register is not None:
+                self.register(lib)
+            self._lib = lib
+            return self._lib
+
+
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_i16p = np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+
+
+def _register_entropy(lib: ctypes.CDLL) -> None:
+    sig = [
+        _i16p, _i16p, _i16p, ctypes.c_int, ctypes.c_int,
+        _u32p, _u8p, _u32p, _u8p, _u32p, _u8p, _u32p, _u8p,
+        _u8p, ctypes.c_int64,
+    ]
+    for name in ("jpeg_encode_scan_420", "jpeg_encode_scan_444"):
+        fn = getattr(lib, name)
+        fn.argtypes = sig
+        fn.restype = ctypes.c_int64
+
+
+def _register_cavlc(lib: ctypes.CDLL) -> None:
+    fn = lib.h264_encode_picture
+    fn.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+        _i32p, _i32p, _i32p, _i32p, _i32p,
+        _u8p, ctypes.c_int64,
+    ]
+    fn.restype = ctypes.c_int64
+
+
+def _register_conformance(lib: ctypes.CDLL) -> None:
+    i32p = ctypes.POINTER(ctypes.c_int)
+    lib.conf_h264_new.restype = ctypes.c_void_p
+    lib.conf_mjpeg_new.restype = ctypes.c_void_p
+    lib.conf_dec_free.argtypes = [ctypes.c_void_p]
+    caps = [ctypes.c_int64, ctypes.c_int64]
+    lib.conf_dec_decode.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_int64,
+                                    _u8p, _u8p, _u8p, *caps, i32p, i32p]
+    lib.conf_dec_decode.restype = ctypes.c_int
+    lib.conf_dec_flush.argtypes = [ctypes.c_void_p, _u8p, _u8p, _u8p,
+                                   *caps, i32p, i32p]
+    lib.conf_dec_flush.restype = ctypes.c_int
+
+
+def _register_audio(lib: ctypes.CDLL) -> None:
+    lib.sa_opus_available.restype = ctypes.c_int
+    lib.sa_pulse_available.restype = ctypes.c_int
+    lib.sa_enc_new.argtypes = [ctypes.c_int] * 7
+    lib.sa_enc_new.restype = ctypes.c_void_p
+    lib.sa_enc_encode.argtypes = [ctypes.c_void_p, _i16p, ctypes.c_int,
+                                  _u8p, ctypes.c_int32]
+    lib.sa_enc_encode.restype = ctypes.c_int
+    lib.sa_enc_free.argtypes = [ctypes.c_void_p]
+    lib.sa_dec_new.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.sa_dec_new.restype = ctypes.c_void_p
+    lib.sa_dec_decode.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_int32,
+                                  _i16p, ctypes.c_int]
+    lib.sa_dec_decode.restype = ctypes.c_int
+    lib.sa_dec_free.argtypes = [ctypes.c_void_p]
+    lib.sa_pa_new.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                              ctypes.c_int, ctypes.c_char_p]
+    lib.sa_pa_new.restype = ctypes.c_void_p
+    lib.sa_pa_read.argtypes = [ctypes.c_void_p, _i16p, ctypes.c_int64]
+    lib.sa_pa_read.restype = ctypes.c_int
+    lib.sa_pa_write.argtypes = [ctypes.c_void_p, _i16p, ctypes.c_int64]
+    lib.sa_pa_write.restype = ctypes.c_int
+    lib.sa_pa_free.argtypes = [ctypes.c_void_p]
+
+
+_ENTROPY = _LazyLib("entropy", register=_register_entropy)
+_CAVLC = _LazyLib("cavlc", register=_register_cavlc)
+_CONFORMANCE = _LazyLib("conformance", ("-lavcodec", "-lavutil"),
+                        _register_conformance)
+_AUDIO = _LazyLib("audio", ("-ldl",), _register_audio)
 
 
 def entropy_lib() -> Optional[ctypes.CDLL]:
-    """The compiled entropy coder, or None if unavailable."""
-    global _lib, _tried
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if _stale(_SO, _SRC) and not _compile():
-            return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError as e:
-            logger.warning("native entropy coder load failed: %s", e)
-            return None
-        i16p = np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS")
-        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
-        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
-        sig = [
-            i16p, i16p, i16p, ctypes.c_int, ctypes.c_int,
-            u32p, u8p, u32p, u8p, u32p, u8p, u32p, u8p,
-            u8p, ctypes.c_int64,
-        ]
-        for name in ("jpeg_encode_scan_420", "jpeg_encode_scan_444"):
-            fn = getattr(lib, name)
-            fn.argtypes = sig
-            fn.restype = ctypes.c_int64
-        _lib = lib
-        return _lib
+    """The compiled JPEG entropy coder, or None if unavailable."""
+    return _ENTROPY.get()
 
 
-# ---------------------------------------------------------------------------
-# CAVLC slice coder (H.264 tpuenc v1)
-
-_CAVLC_SRC = os.path.join(_DIR, "cavlc.cpp")
-_CAVLC_SO = os.path.join(_DIR, "_libselkies_cavlc.so")
-_cavlc_lock = threading.Lock()
-_cavlc_lib: Optional[ctypes.CDLL] = None
-_cavlc_tried = False
-
-
-_CONF_SRC = os.path.join(_DIR, "conformance.cpp")
-_CONF_SO = os.path.join(_DIR, "_libselkies_conformance.so")
-_conf_lock = threading.Lock()
-_conf_lib: Optional[ctypes.CDLL] = None
-_conf_tried = False
+def cavlc_lib() -> Optional[ctypes.CDLL]:
+    """The compiled H.264 CAVLC slice coder, or None if unavailable."""
+    return _CAVLC.get()
 
 
 def conformance_lib() -> Optional[ctypes.CDLL]:
@@ -105,59 +165,9 @@ def conformance_lib() -> Optional[ctypes.CDLL]:
     H.264 and JFIF output with a production decoder, standing in for the
     browser's WebCodecs decoders.
     """
-    global _conf_lib, _conf_tried
-    with _conf_lock:
-        if _conf_lib is not None or _conf_tried:
-            return _conf_lib
-        _conf_tried = True
-        if _stale(_CONF_SO, _CONF_SRC) and not _compile_lib(
-                _CONF_SRC, _CONF_SO, ("-lavcodec", "-lavutil")):
-            return None
-        try:
-            lib = ctypes.CDLL(_CONF_SO)
-        except OSError as e:
-            logger.warning("conformance decoder load failed: %s", e)
-            return None
-        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
-        i32p = ctypes.POINTER(ctypes.c_int)
-        lib.conf_h264_new.restype = ctypes.c_void_p
-        lib.conf_mjpeg_new.restype = ctypes.c_void_p
-        lib.conf_dec_free.argtypes = [ctypes.c_void_p]
-        caps = [ctypes.c_int64, ctypes.c_int64]
-        lib.conf_dec_decode.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int64,
-                                        u8p, u8p, u8p, *caps, i32p, i32p]
-        lib.conf_dec_decode.restype = ctypes.c_int
-        lib.conf_dec_flush.argtypes = [ctypes.c_void_p, u8p, u8p, u8p,
-                                       *caps, i32p, i32p]
-        lib.conf_dec_flush.restype = ctypes.c_int
-        _conf_lib = lib
-        return _conf_lib
+    return _CONFORMANCE.get()
 
 
-def cavlc_lib() -> Optional[ctypes.CDLL]:
-    """The compiled H.264 CAVLC slice coder, or None if unavailable."""
-    global _cavlc_lib, _cavlc_tried
-    with _cavlc_lock:
-        if _cavlc_lib is not None or _cavlc_tried:
-            return _cavlc_lib
-        _cavlc_tried = True
-        if _stale(_CAVLC_SO, _CAVLC_SRC) and not _compile_lib(
-                _CAVLC_SRC, _CAVLC_SO):
-            return None
-        try:
-            lib = ctypes.CDLL(_CAVLC_SO)
-        except OSError as e:
-            logger.warning("cavlc coder load failed: %s", e)
-            return None
-        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
-        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
-        fn = lib.h264_encode_picture
-        fn.argtypes = [
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int,
-            i32p, i32p, i32p, i32p, i32p,
-            u8p, ctypes.c_int64,
-        ]
-        fn.restype = ctypes.c_int64
-        _cavlc_lib = lib
-        return _cavlc_lib
+def audio_lib() -> Optional[ctypes.CDLL]:
+    """Opus/Pulse audio runtime (the pcmflux equivalent), or None."""
+    return _AUDIO.get()

@@ -124,6 +124,7 @@ class DataStreamingServer:
         self._stop_event: Optional[asyncio.Event] = None
         self.bytes_sent = 0
         self.audio_pipeline = None  # wired by main() when audio is enabled
+        self._audio_wanted = True   # cleared by STOP_AUDIO until re-requested
 
     # ------------------------------------------------------------------
     # broadcast primitives
@@ -169,6 +170,9 @@ class DataStreamingServer:
     async def stop(self) -> None:
         for st in list(self.display_clients.values()):
             await self._stop_display(st)
+        if self.audio_pipeline is not None:
+            await self.audio_pipeline.stop()
+            self.audio_pipeline.close()
         if self._stats_task:
             self._stats_task.cancel()
         if self._stop_event:
@@ -180,6 +184,9 @@ class DataStreamingServer:
     async def ws_handler(self, websocket) -> None:
         self.clients.add(websocket)
         try:
+            if (self.audio_pipeline is not None and self._audio_wanted
+                    and not self.audio_pipeline.running):
+                await self.audio_pipeline.start()
             await websocket.send("MODE websockets")
             if self.app and self.app.last_cursor_sent:
                 await websocket.send(
@@ -201,6 +208,9 @@ class DataStreamingServer:
                 if st.ws is websocket:
                     await self._stop_display(st)
                     del self.display_clients[st.display_id]
+            if (not self.clients and self.audio_pipeline is not None
+                    and self.audio_pipeline.running):
+                await self.audio_pipeline.stop()
 
     # ------------------------------------------------------------------
     # text protocol
@@ -233,10 +243,12 @@ class DataStreamingServer:
                 await self._stop_display(st)
                 await websocket.send("VIDEO_STOPPED")
         elif verb == "START_AUDIO":
+            self._audio_wanted = True
             if self.audio_pipeline is not None:
                 await self.audio_pipeline.start()
                 self.broadcast("AUDIO_STARTED")
         elif verb == "STOP_AUDIO":
+            self._audio_wanted = False
             if self.audio_pipeline is not None:
                 await self.audio_pipeline.stop()
                 self.broadcast("AUDIO_STOPPED")

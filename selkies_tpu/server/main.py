@@ -23,6 +23,21 @@ async def _amain(settings: Settings) -> int:
     server = DataStreamingServer(settings, app=app)
     app.data_server = server
 
+    if settings.audio_enabled.value:
+        try:
+            from ..audio import AudioCaptureSettings, AudioPipeline, opus_available
+
+            if opus_available():
+                server.audio_pipeline = AudioPipeline(server, AudioCaptureSettings(
+                    device_name=settings.audio_device_name.value,
+                    opus_bitrate=int(settings.audio_bitrate.value),
+                    use_silence_gate=True))
+            else:
+                logging.getLogger("selkies_tpu").warning(
+                    "audio disabled: libopus unavailable")
+        except Exception:
+            logging.getLogger("selkies_tpu").exception("audio init failed")
+
     input_handler = None
     cursor_monitor = None
     try:
