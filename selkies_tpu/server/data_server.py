@@ -62,12 +62,13 @@ def default_encoder_factory(
     )
 
 
-def default_source_factory(width: int, height: int, fps: float):
+def default_source_factory(width: int, height: int, fps: float,
+                           x: int = 0, y: int = 0):
     from ..capture.x11 import X11Source
     from ..capture.synthetic import SyntheticSource
 
     if X11Source.available():
-        return X11Source(width, height, fps)
+        return X11Source(width, height, fps, x=x, y=y)
     return SyntheticSource(width, height, fps, pattern="desktop")
 
 
@@ -77,6 +78,9 @@ class DisplayState:
     ws: Any = None
     width: int = 1024
     height: int = 768
+    #: framebuffer offset of this display (set by _apply_x11_layout)
+    x: int = 0
+    y: int = 0
     bp: BackpressureState = field(default_factory=BackpressureState)
     #: serializes start/stop/reconfigure (they await mid-flight, so two
     #: concurrent calls could otherwise both pass the is-running guard and
@@ -125,6 +129,7 @@ class DataStreamingServer:
         self.bytes_sent = 0
         self.audio_pipeline = None  # wired by main() when audio is enabled
         self._audio_wanted = True   # cleared by STOP_AUDIO until re-requested
+        self._last_layout = None    # last xrandr-applied Layout (dedup)
 
     # ------------------------------------------------------------------
     # broadcast primitives
@@ -204,10 +209,15 @@ class DataStreamingServer:
         finally:
             self.clients.discard(websocket)
             self._uploads.pop(websocket, None)
+            dropped = False
             for st in list(self.display_clients.values()):
                 if st.ws is websocket:
                     await self._stop_display(st)
                     del self.display_clients[st.display_id]
+                    dropped = True
+            if dropped and self.display_clients:
+                # surviving displays reflow into a smaller framebuffer
+                await self._reconfigure_displays()
             if (not self.clients and self.audio_pipeline is not None
                     and self.audio_pipeline.running):
                 await self.audio_pipeline.stop()
@@ -359,7 +369,17 @@ class DataStreamingServer:
             st.bp.framerate = float(applied["framerate"])
         logger.info("client settings for %s: %s", display_id, applied)
 
-        await self.reconfigure_display(st)
+        if "scaling_dpi" in applied:
+            await self._apply_dpi(int(applied["scaling_dpi"]))
+        await self._reconfigure_displays()
+
+    async def _apply_dpi(self, dpi: int) -> None:
+        from ..display import DpiManager
+
+        try:
+            await asyncio.to_thread(DpiManager().set_dpi, dpi)
+        except ValueError as e:
+            logger.warning("dpi rejected: %s", e)
 
     async def _on_resize(self, websocket, args) -> None:
         if self.settings.is_manual_resolution_mode.value:
@@ -374,12 +394,63 @@ class DataStreamingServer:
         if not st:
             return
         st.width, st.height = max(16, w & ~1), max(16, h & ~1)
-        await self.reconfigure_display(st)
+        await self._reconfigure_displays()
         self.broadcast(json.dumps({
             "type": "stream_resolution",
             "width": st.width,
             "height": st.height,
         }))
+
+    async def _reconfigure_displays(self) -> None:
+        """Full display-plane reconfiguration (reference reconfigure_displays
+        selkies.py:2616): stop every capture, re-arrange the X screen, then
+        restart active pipelines with their new geometry/offsets.  Captures
+        stop FIRST so no XGetImage ever races a shrinking root window."""
+        for st in self.display_clients.values():
+            await self._stop_display(st)
+        await self._apply_x11_layout()
+        for st in self.display_clients.values():
+            if st.video_active and st.ws is not None:
+                await self._start_display(st)
+
+    async def _apply_x11_layout(self) -> None:
+        """Arrange the client displays into one framebuffer and mirror it
+        onto the real X screen (xrandr modes, --fb, --setmonitor).  Always
+        updates per-display capture offsets; the xrandr half is skipped on
+        hosts without it (synthetic capture) or when the layout is unchanged
+        since the last apply."""
+        from ..display import (XrandrManager, compute_layout,
+                               xrandr_available)
+
+        if not self.display_clients:
+            return
+        displays = {d: (st.width, st.height)
+                    for d, st in self.display_clients.items()}
+        primary = self.display_clients.get("primary")
+        position = ((primary.overrides.get("second_screen_position")
+                     if primary else None)
+                    or self.settings.second_screen_position)
+        try:
+            layout = compute_layout(displays, position)
+        except ValueError as e:
+            logger.warning("layout rejected: %s", e)
+            return
+        for p in layout.placements:
+            stp = self.display_clients.get(p.display_id)
+            if stp:
+                stp.x, stp.y = p.x, p.y
+        if not xrandr_available() or layout == self._last_layout:
+            return
+        try:
+            mgr = XrandrManager()
+            if len(layout.placements) == 1:
+                p = layout.placements[0]
+                await asyncio.to_thread(mgr.resize, p.width, p.height)
+            else:
+                await asyncio.to_thread(mgr.apply_layout, layout)
+            self._last_layout = layout
+        except Exception as e:
+            logger.warning("x11 layout apply failed: %s", e)
 
     # ------------------------------------------------------------------
     # frame-id reset protocol
@@ -444,7 +515,11 @@ class DataStreamingServer:
                 st.width, st.height, self.settings, st.overrides)
         except TypeError:  # factory without overrides support (tests, custom)
             encoder = self.encoder_factory(st.width, st.height, self.settings)
-        source = self.source_factory(st.width, st.height, fps)
+        try:
+            source = self.source_factory(st.width, st.height, fps,
+                                         x=st.x, y=st.y)
+        except TypeError:  # factory without offset support (tests, custom)
+            source = self.source_factory(st.width, st.height, fps)
         source.start()
         frame_id = 0
         interval = 1.0 / fps

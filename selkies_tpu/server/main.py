@@ -29,8 +29,8 @@ async def _amain(settings: Settings) -> int:
 
             if opus_available():
                 server.audio_pipeline = AudioPipeline(server, AudioCaptureSettings(
-                    device_name=settings.audio_device_name.value,
-                    opus_bitrate=int(settings.audio_bitrate.value),
+                    device_name=settings.audio_device_name,
+                    opus_bitrate=int(settings.audio_bitrate),
                     use_silence_gate=True))
             else:
                 logging.getLogger("selkies_tpu").warning(

@@ -216,6 +216,9 @@ SETTING_DEFINITIONS: List[Spec] = [
     RangeSpec("h264_paintover_crf", "5-50", "H.264 paint-over CRF.", default_value=18),
     RangeSpec("h264_paintover_burst_frames", "1-30", "Paint-over burst frames.", default_value=5),
     BoolSpec("second_screen", True, "Enable a second monitor/display."),
+    EnumSpec("second_screen_position", "right",
+             "Secondary display placement relative to the primary.",
+             allowed=("right", "left", "up", "down")),
 
     # Audio
     EnumSpec("audio_bitrate", "320000", "Default audio bitrate.",

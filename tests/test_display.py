@@ -1,0 +1,219 @@
+"""Display plane: GTF modelines, layout geometry, xrandr command grammar,
+DPI fan-out (reference parity: selkies.py:216-470, 2616-2779)."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.display import (DpiManager, XrandrManager, compute_layout,
+                                 fit_res, gtf_modeline, parse_res)
+
+
+# ---------------------------------------------------------------------------
+# modeline
+
+
+def test_gtf_1080p60_matches_gtf_utility():
+    """Canonical `gtf 1920 1080 60` output:
+    172.80 MHz, 1920 2040 2248 2576, 1080 1081 1084 1118."""
+    m = gtf_modeline(1920, 1080, 60)
+    assert m.pclk_mhz == pytest.approx(172.80, abs=0.01)
+    assert (m.hdisp, m.hsync_start, m.hsync_end, m.htotal) == (
+        1920, 2040, 2248, 2576)
+    assert (m.vdisp, m.vsync_start, m.vsync_end, m.vtotal) == (
+        1080, 1081, 1084, 1118)
+
+
+def test_gtf_1024x768_matches_gtf_utility():
+    """Canonical `gtf 1024 768 60`: 64.11 MHz, 1024 1080 1184 1344,
+    768 769 772 795."""
+    m = gtf_modeline(1024, 768, 60)
+    assert m.pclk_mhz == pytest.approx(64.11, abs=0.01)
+    assert (m.hdisp, m.hsync_start, m.hsync_end, m.htotal) == (
+        1024, 1080, 1184, 1344)
+    assert (m.vdisp, m.vsync_start, m.vsync_end, m.vtotal) == (
+        768, 769, 772, 795)
+
+
+def test_gtf_refresh_close_to_request():
+    for w, h, r in [(1920, 1080, 60), (2560, 1440, 75), (803, 601, 60),
+                    (640, 480, 120)]:
+        m = gtf_modeline(w, h, r)
+        assert m.refresh_hz == pytest.approx(r, rel=0.01), (w, h, r)
+        # xrandr args shape
+        args = m.xrandr_args()
+        assert len(args) == 12 and args[-2:] == ["-HSync", "+VSync"]
+
+
+def test_gtf_rejects_nonsense():
+    with pytest.raises(ValueError):
+        gtf_modeline(0, 1080)
+    with pytest.raises(ValueError):
+        gtf_modeline(1920, 1080, -5)
+
+
+# ---------------------------------------------------------------------------
+# layout / sanitizers
+
+
+def test_parse_res_even_aligns():
+    assert parse_res("1921x1081") == (1920, 1080)
+    assert parse_res("640X480") == (640, 480)
+    for bad in ("", "x", "axb", "-2x100", "0x0"):
+        with pytest.raises(ValueError):
+            parse_res(bad)
+
+
+def test_fit_res_preserves_aspect():
+    w, h = fit_res(3840, 2160, 1920, 1200)
+    assert (w, h) == (1920, 1080)
+    assert fit_res(800, 600, 1920, 1080) == (800, 600)
+
+
+def test_layout_right_left_up_down():
+    d = {"primary": (1920, 1080), "display2": (1280, 720)}
+    right = compute_layout(d, "right")
+    assert (right.fb_width, right.fb_height) == (3200, 1080)
+    assert right.offset_of("primary") == (0, 0)
+    assert right.offset_of("display2") == (1920, 0)
+
+    left = compute_layout(d, "left")
+    assert (left.fb_width, left.fb_height) == (3200, 1080)
+    assert left.offset_of("display2") == (0, 0)
+    assert left.offset_of("primary") == (1280, 0)
+
+    down = compute_layout(d, "down")
+    assert (down.fb_width, down.fb_height) == (1920, 1800)
+    assert down.offset_of("primary") == (0, 0)
+    assert down.offset_of("display2") == (0, 1080)
+
+    up = compute_layout(d, "up")
+    assert up.offset_of("display2") == (0, 0)
+    assert up.offset_of("primary") == (0, 720)
+
+
+def test_layout_single_display():
+    lay = compute_layout({"primary": (1280, 800)})
+    assert (lay.fb_width, lay.fb_height) == (1280, 800)
+    assert lay.placements[0].display_id == "primary"
+
+
+# ---------------------------------------------------------------------------
+# xrandr command grammar (fake runner; no X server needed)
+
+XRANDR_QUERY = """\
+Screen 0: minimum 8 x 8, current 1920 x 1080, maximum 16384 x 16384
+DVI-D-0 connected primary 1920x1080+0+0 (normal left inverted) 530mm x 300mm
+   1920x1080     60.00*+  59.94
+   1280x720      60.00
+HDMI-0 disconnected (normal left inverted right x axis y axis)
+"""
+
+LISTMONITORS = """\
+Monitors: 2
+ 0: +*selkies-primary 1920/530x1080/300+0+0  DVI-D-0
+ 1: +selkies-display2 1280/340x720/190+1920+0
+"""
+
+
+class FakeRunner:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, argv):
+        self.calls.append(list(argv))
+        if "--query" in argv:
+            return 0, XRANDR_QUERY
+        if "--listmonitors" in argv:
+            return 0, LISTMONITORS
+        return 0, ""
+
+
+def test_connected_outputs_and_modes():
+    r = FakeRunner()
+    mgr = XrandrManager(runner=r)
+    assert mgr.connected_outputs() == ["DVI-D-0"]
+    assert mgr.output_modes("DVI-D-0") == ["1920x1080", "1280x720"]
+    assert mgr.output_modes("HDMI-0") == []
+
+
+def test_ensure_mode_prefers_existing_native():
+    r = FakeRunner()
+    mgr = XrandrManager(runner=r)
+    assert mgr.ensure_mode("DVI-D-0", 1920, 1080) == "1920x1080"
+    assert not any("--newmode" in c for c in r.calls)
+
+
+def test_ensure_mode_creates_gtf_mode():
+    r = FakeRunner()
+    mgr = XrandrManager(runner=r)
+    name = mgr.ensure_mode("DVI-D-0", 1600, 900)
+    assert name == "1600x900_60.00"
+    newmode = next(c for c in r.calls if "--newmode" in c)
+    i = newmode.index("--newmode")
+    assert newmode[i + 1] == "1600x900_60.00"
+    addmode = next(c for c in r.calls if "--addmode" in c)
+    assert addmode[-2:] == ["DVI-D-0", "1600x900_60.00"]
+
+
+def test_resize_issues_output_mode():
+    r = FakeRunner()
+    mgr = XrandrManager(runner=r)
+    mode = mgr.resize(1280, 720)
+    assert mode == "1280x720"
+    assert ["xrandr", "--output", "DVI-D-0", "--mode", "1280x720"] in r.calls
+
+
+def test_apply_layout_full_grammar():
+    r = FakeRunner()
+    mgr = XrandrManager(runner=r)
+    lay = compute_layout({"primary": (1920, 1080), "display2": (1280, 720)},
+                         "right")
+    mgr.apply_layout(lay)
+    flat = ["\x00".join(c) for c in r.calls]
+    # stale logical monitors removed
+    assert any("--delmonitor\x00selkies-primary" in f for f in flat)
+    assert any("--delmonitor\x00selkies-display2" in f for f in flat)
+    # framebuffer grown
+    assert ["xrandr", "--fb", "3200x1080"] in r.calls
+    # one logical monitor per placement, geometry WxH+X+Y with mm spans
+    setmons = [c for c in r.calls if "--setmonitor" in c]
+    geoms = {c[c.index("--setmonitor") + 1]: c[c.index("--setmonitor") + 2]
+             for c in setmons}
+    assert geoms["selkies-primary"] == "1920/1920x1080/1080+0+0"
+    assert geoms["selkies-display2"] == "1280/1280x720/720+1920+0"
+
+
+def test_monitor_parsing():
+    r = FakeRunner()
+    mgr = XrandrManager(runner=r)
+    assert mgr.list_monitors() == ["selkies-primary", "selkies-display2"]
+
+
+# ---------------------------------------------------------------------------
+# DPI
+
+
+def test_dpi_validation_and_fanout(monkeypatch):
+    calls = []
+
+    def runner(argv):
+        calls.append(list(argv))
+        return 0, ""
+
+    monkeypatch.setattr("selkies_tpu.display.dpi._have", lambda t: True)
+    mgr = DpiManager(runner=runner)
+    assert mgr.set_dpi(120)
+    joined = [" ".join(c) for c in calls]
+    assert any("Xft.dpi: 120" in j for j in joined)
+    assert any("/Xft/DPI" in j and "120" in j for j in joined)
+    assert any("text-scaling-factor 1.25" in j for j in joined)
+    with pytest.raises(ValueError):
+        mgr.set_dpi(5)
+
+    calls.clear()
+    assert mgr.set_cursor_size(48)
+    joined = [" ".join(c) for c in calls]
+    assert any("cursor-size 48" in j for j in joined)
+    assert any("Xcursor.size: 48" in j for j in joined)
+    with pytest.raises(ValueError):
+        mgr.set_cursor_size(0)

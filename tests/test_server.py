@@ -359,3 +359,112 @@ async def test_resize_resets_frame_ids(tmp_path):
     finally:
         await server.stop()
         srv.close()
+
+
+@pytest.mark.anyio
+async def test_multi_display_layout_drives_xrandr(tmp_path, monkeypatch):
+    """Two displays attach → the server computes the extended layout, sets
+    capture offsets, and (with xrandr 'available') issues the monitor
+    grammar; secondary disconnect reflows back to a single display."""
+    import selkies_tpu.display as disp_pkg
+    import selkies_tpu.display.xrandr as xr_mod
+
+    calls = []
+
+    class FakeXrandr:
+        def __init__(self, *a, **k):
+            pass
+
+        def resize(self, w, h, refresh=60.0, output=None):
+            calls.append(("resize", w, h))
+            return f"{w}x{h}"
+
+        def apply_layout(self, layout, refresh=60.0):
+            calls.append(("layout", layout.fb_width, layout.fb_height,
+                          tuple((p.display_id, p.x, p.y)
+                                for p in layout.placements)))
+
+    monkeypatch.setattr(disp_pkg, "xrandr_available", lambda: True)
+    monkeypatch.setattr(disp_pkg, "XrandrManager", FakeXrandr)
+
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}/") as ws1:
+            await handshake(ws1)
+            await ws1.send("SETTINGS," + json.dumps(
+                {"displayId": "primary", "initialClientWidth": 1920,
+                 "initialClientHeight": 1080}))
+            await asyncio.sleep(0.3)
+            assert ("resize", 1920, 1080) in calls
+
+            async with websockets.connect(f"ws://127.0.0.1:{port}/") as ws2:
+                await handshake(ws2)
+                await ws2.send("SETTINGS," + json.dumps(
+                    {"displayId": "display2", "initialClientWidth": 1280,
+                     "initialClientHeight": 720}))
+                await asyncio.sleep(0.3)
+                layouts = [c for c in calls if c[0] == "layout"]
+                assert layouts, calls
+                _, fbw, fbh, placements = layouts[-1]
+                assert (fbw, fbh) == (3200, 1080)
+                assert ("display2", 1920, 0) in placements
+                # capture offsets landed on the display state
+                st2 = server.display_clients["display2"]
+                assert (st2.x, st2.y) == (1920, 0)
+
+            # secondary gone → reflow to single display
+            await asyncio.sleep(0.4)
+            assert ("resize", 1920, 1080) in calls[-2:] or \
+                ("resize", 1920, 1080) in calls
+            assert "display2" not in server.display_clients
+    finally:
+        srv.close()
+        await srv.wait_closed()
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_layout_dedup_skips_repeat_xrandr(tmp_path, monkeypatch):
+    import selkies_tpu.display as disp_pkg
+
+    calls = []
+
+    class FakeXrandr:
+        def __init__(self, *a, **k):
+            pass
+
+        def resize(self, w, h, refresh=60.0, output=None):
+            calls.append((w, h))
+            return f"{w}x{h}"
+
+        def apply_layout(self, layout, refresh=60.0):
+            calls.append(("multi",))
+
+    monkeypatch.setattr(disp_pkg, "xrandr_available", lambda: True)
+    monkeypatch.setattr(disp_pkg, "XrandrManager", FakeXrandr)
+
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}/") as ws:
+            await handshake(ws)
+            await ws.send("SETTINGS," + json.dumps(
+                {"displayId": "primary", "initialClientWidth": 1024,
+                 "initialClientHeight": 768}))
+            await asyncio.sleep(0.3)
+            n_after_settings = len(calls)
+            # same-geometry settings again → no new xrandr traffic
+            await ws.send("SETTINGS," + json.dumps(
+                {"displayId": "primary", "initialClientWidth": 1024,
+                 "initialClientHeight": 768}))
+            await asyncio.sleep(0.3)
+            assert len(calls) == n_after_settings
+            # a real resize does reach xrandr
+            await ws.send("r,800x600")
+            await asyncio.sleep(0.3)
+            assert calls[-1] == (800, 600)
+    finally:
+        srv.close()
+        await srv.wait_closed()
+        await server.stop()
