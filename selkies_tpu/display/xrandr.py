@@ -149,9 +149,15 @@ class XrandrManager:
             raise RuntimeError("no connected outputs")
         primary_out = outputs[0]
         # the real output spans the whole framebuffer; logical monitors
-        # carve it up for the window manager
-        self.ensure_mode(primary_out, layout.fb_width, layout.fb_height,
-                         refresh)
+        # carve it up for the window manager.  The mode must actually be
+        # activated on the output — otherwise xrandr rejects any --fb
+        # smaller than the stale active CRTC mode.
+        mode_name = self.ensure_mode(primary_out, layout.fb_width,
+                                     layout.fb_height, refresh)
+        rc, _ = self._xrandr("--output", primary_out, "--mode", mode_name)
+        if rc != 0:
+            logger.warning("--output %s --mode %s failed", primary_out,
+                           mode_name)
         rc, _ = self._xrandr("--fb",
                              f"{layout.fb_width}x{layout.fb_height}")
         if rc != 0:

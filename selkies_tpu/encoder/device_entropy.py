@@ -4,27 +4,43 @@ Why: pulling DCT coefficients to the host costs ~6 MB/frame of D2H traffic —
 the dominant cost on PCIe-attached chips at high session counts and fatal on
 tunneled devices. Entropy coding *on device* shrinks the per-frame transfer to
 the compressed bitstream itself (tens of KB). This is SURVEY.md §7 "hard part
-1" resolved in favor of option (a'): a data-parallel formulation of Huffman
-coding that fits XLA/TPU:
+1" resolved as a data-parallel Huffman formulation that fits XLA/TPU.
 
-  1. blocks are gathered into JPEG MCU scan order (static permutation);
-  2. DC deltas come from a static predecessor-index gather (the serial DC
-     chain is just a shifted subtraction in scan order);
-  3. zero-run lengths come from an inclusive ``cummax`` of nonzero positions
-     (the only "sequential" part of RLE, done as an associative scan);
-  4. every coefficient expands into ≤4 fixed symbol slots (3 ZRL + 1 value;
-     a run ≤62 needs ≤3 ZRLs), giving a dense [blocks, 254] symbol grid;
-  5. symbol bit offsets are a segmented cumulative sum (per stripe);
-  6. bit packing exploits that contributions to one 32-bit output word have
-     disjoint bits: word values are recovered from a plain (wrapping) cumsum
-     of per-symbol word contributions differenced at word boundaries found
-     by ``searchsorted`` — no scatter, no atomics;
-  7. stripes are padded with 1-bits to byte alignment (T.81 F.1.2.3) via one
-     synthetic trailing symbol per stripe, then compacted back-to-back at
-     word granularity so the host fetches one dense buffer.
+v2 design notes (why it looks the way it does): TPU random-access ops
+(gather/scatter/searchsorted) cost ~10 ns *per element* on the scalar core,
+so the v1 formulation — a [blocks, 254] dense symbol grid with a global
+12.4M-element cumsum and a 557k-query ``searchsorted`` — spent ~340 ms/frame
+at 1080p almost entirely in scalar-core ops. v2 eliminates every large
+irregular access:
+
+  1. symbols live in a [M, 192] per-block slot grid (DC code, DC bits, and
+     per-AC-coefficient {ZRL-pair, ZRL+code, value-bits} triples — each slot
+     ≤ 27 bits so a slot spans ≤ 2 of the block's 32-bit words);
+  2. Huffman code/length lookup is a two-level one-hot *matmul* (MXU) over a
+     packed (code<<5|len) table — ~6× faster than ``jnp.take``'s gather;
+  3. slots pack into ≤ W per-block words with a masked compare-and-sum
+     contraction (VPU-friendly; no scatter);
+  4. block base offsets are a per-stripe cumsum over block *totals* (M-sized,
+     not symbol-sized), and each block word lands in global words
+     ``g0+w`` / ``g0+w+1`` — an *analytic* index, linear in w;
+  5. per-output-word sums use the cumsum-difference trick where the segment
+     boundary is computed analytically from (4): the boundary block comes
+     from a tiny 49k scatter-max + cummax, and the boundary slot within it
+     is ``min(w - g0, W-1)`` — no searchsorted anywhere;
+  6. stripes are padded with 1-bits to byte alignment (T.81 F.1.2.3) and
+     compacted back-to-back at word granularity so the host fetches one
+     dense buffer.
 
 The output is bit-exact with the host coders (entropy_py / native); byte
 stuffing (0xFF→0xFF00) happens on host over the ~75 KB result.
+
+Overflow containment: a block whose bitstream exceeds ``32*block_words``
+bits, or a stripe exceeding ``max_stripe_bytes``, flags its stripe in the
+returned ``overflow`` array; flagged stripes are host-coded by the caller
+(encoder/jpeg.py _scans_from_packed). The default ``block_words=56`` covers
+the worst legal JPEG block (~1660 bits), so overflow can only be a stripe-
+size event; the streaming pipeline uses the faster ``block_words=16``
+variant where pathological blocks fall back to the host coder.
 """
 
 from __future__ import annotations
@@ -92,16 +108,6 @@ def scan_geometry(pad_h: int, pad_w: int, stripe_h: int):
     )
 
 
-def _huff_arrays():
-    """Stacked [2, 256] (luma, chroma) code/length arrays for DC and AC."""
-    dc_l, ac_l, dc_c, ac_c = std_tables()
-    dc_code = np.stack([dc_l.code_arr, dc_c.code_arr]).astype(np.uint32)
-    dc_len = np.stack([dc_l.len_arr, dc_c.len_arr]).astype(np.int32)
-    ac_code = np.stack([ac_l.code_arr, ac_c.code_arr]).astype(np.uint32)
-    ac_len = np.stack([ac_l.len_arr, ac_c.len_arr]).astype(np.int32)
-    return dc_code, dc_len, ac_code, ac_len
-
-
 def _bitlen(a):
     """Magnitude category of |a| (int32, |a| ≤ 2047): exact via f32 log2."""
     af = jnp.abs(a).astype(jnp.float32)
@@ -115,17 +121,29 @@ def _vbits(v, size):
     return (raw & ((1 << size) - 1)).astype(jnp.uint32)
 
 
-def _sorted_segment_words(word_idx, contrib, n_words):
-    """Sum contributions grouped by (sorted, non-decreasing) word index.
+def _packed_ac_tables() -> np.ndarray:
+    """[512] float32 packed (code<<5 | len) AC table, luma then chroma."""
+    _, ac_l, _, ac_c = std_tables()
+    packed = np.zeros(512, np.float32)
+    for comp, tbl in ((0, ac_l), (1, ac_c)):
+        packed[comp * 256:(comp + 1) * 256] = (
+            tbl.code_arr.astype(np.int64) << 5) + tbl.len_arr.astype(np.int64)
+    return packed
 
-    Within a word all contributions have disjoint bits, so their u32 sum is
-    exact; the wrapping cumsum across words cancels in the difference.
+
+def _lut512(idx_flat):
+    """packed = table[idx] for idx ∈ [0, 512), via two-level one-hot matmul.
+
+    ``jnp.take`` gathers cost ~10 ns/element on the TPU scalar core (~25 ms
+    at 3.1M lookups); routing the same lookup through the MXU costs ~2 ms.
+    Values are ≤ 2^21 so float32 arithmetic is exact.
     """
-    cs = jnp.cumsum(contrib.astype(jnp.uint32), dtype=jnp.uint32)
-    hi = jnp.searchsorted(word_idx, jnp.arange(n_words, dtype=word_idx.dtype),
-                          side="right")
-    s_at = jnp.where(hi > 0, cs[jnp.maximum(hi - 1, 0)], 0)
-    return s_at - jnp.concatenate([jnp.zeros((1,), jnp.uint32), s_at[:-1]])
+    table = _packed_ac_tables().reshape(32, 16)
+    hi = idx_flat >> 4
+    lo = idx_flat & 15
+    rows = jax.nn.one_hot(hi, 32, dtype=jnp.float32) @ jnp.asarray(table)
+    picked = (rows * jax.nn.one_hot(lo, 16, dtype=jnp.float32)).sum(-1)
+    return picked.astype(jnp.int32)
 
 
 class DeviceEntropyPacker:
@@ -136,182 +154,222 @@ class DeviceEntropyPacker:
              (each stripe starts word-aligned; bits are MSB-first, so bytes
              come from big-endian u32 serialization);
       nbytes [S] int32         — scan byte count per stripe (incl. padding);
-      base_words [S] int32     — word offset of each stripe in ``words``.
+      base_words [S] int32     — word offset of each stripe in ``words``;
+      overflow [S] bool        — stripe unusable (host-code it instead).
     """
 
-    #: symbol slots per block: DC + 63 × (3 ZRL + value) + EOB
-    SLOTS = 254
+    #: slot grid per block: 2 DC slots + 63 × (ZRL-pair, ZRL+code, value) + pad
+    SLOTS = 192
 
     def __init__(
         self,
         pad_h: int,
         pad_w: int,
         stripe_h: int,
-        max_stripe_bytes: int = 1 << 17,
+        max_stripe_bytes: int = 1 << 15,
+        block_words: int = 56,
     ) -> None:
         perm, is_chroma, dc_prev, bps = scan_geometry(pad_h, pad_w, stripe_h)
         self.n_stripes = pad_h // stripe_h
         self.blocks_per_stripe = bps
         self.max_stripe_words = max_stripe_bytes // 4
-        # Sized for the worst case (every stripe at its cap), so compaction
-        # can never spill a stripe past the buffer — an overflowing stripe is
-        # clamped to max_stripe_words and flagged; later stripes stay intact.
+        self.block_words = block_words
         self.cap_words = self.n_stripes * self.max_stripe_words
-        dc_code, dc_len, ac_code, ac_len = _huff_arrays()
 
-        n_stripes = self.n_stripes
-        max_w = self.max_stripe_words
+        dc_l, ac_l, dc_c, ac_c = std_tables()
+        # [2, 12] DC code/len (symbol = magnitude category 0..11)
+        dc_code_t = np.stack([dc_l.code_arr[:12], dc_c.code_arr[:12]]).astype(np.uint32)
+        dc_len_t = np.stack([dc_l.len_arr[:12], dc_c.len_arr[:12]]).astype(np.int32)
+        zrl_c = (int(ac_l.code_arr[0xF0]), int(ac_c.code_arr[0xF0]))
+        zrl_l = (int(ac_l.len_arr[0xF0]), int(ac_c.len_arr[0xF0]))
+        eob_c = (int(ac_l.code_arr[0x00]), int(ac_c.code_arr[0x00]))
+        eob_l = (int(ac_l.len_arr[0x00]), int(ac_c.len_arr[0x00]))
+
+        S = self.n_stripes
+        V = self.max_stripe_words
+        W = self.block_words
+        M = len(perm)
+        SLOTS = self.SLOTS
         cap_words = self.cap_words
-        slots = self.SLOTS
-        syms_per_stripe = bps * slots
+        chroma = jnp.asarray(is_chroma)          # [M]
+        prevd = jnp.asarray(dc_prev)             # [M]
+        permd = jnp.asarray(perm)
 
         def pack_fn(yq, cbq, crq):
             allb = jnp.concatenate(
                 [yq.reshape(-1, 64), cbq.reshape(-1, 64), crq.reshape(-1, 64)]
             ).astype(jnp.int32)
-            stream = allb[jnp.asarray(perm)]                    # [M, 64]
-            chroma = jnp.asarray(is_chroma)                     # [M]
-            m_blocks = stream.shape[0]
+            stream = allb[permd]                                 # [M, 64]
 
-            def lut(table_pair, sym):
-                """Per-block table select without materializing [M, 256]:
-                gather from each 256-entry constant, then pick by component."""
-                tl = jnp.take(jnp.asarray(table_pair[0]), sym)
-                tc = jnp.take(jnp.asarray(table_pair[1]), sym)
-                sel = chroma.reshape((-1,) + (1,) * (sym.ndim - 1)) == 1
-                return jnp.where(sel, tc, tl)
-
-            # ---- DC symbols ------------------------------------------------
+            # ---- DC symbols (per block) -----------------------------------
             dc = stream[:, 0]
-            prev_idx = jnp.asarray(dc_prev)
-            pred = jnp.where(prev_idx < 0, 0, dc[jnp.maximum(prev_idx, 0)])
+            pred = jnp.where(prevd < 0, 0, dc[jnp.maximum(prevd, 0)])
             diff = dc - pred
-            dsize = _bitlen(diff)
-            dcode = lut(dc_code, dsize)
-            dlen = lut(dc_len, dsize)
-            dc_bits = ((dcode << dsize.astype(jnp.uint32))
-                       | _vbits(diff, dsize)).astype(jnp.uint32)
-            dc_slen = dlen + dsize
+            dsize = _bitlen(diff)                                # ≤ 11
+            dci = chroma * 12 + dsize
+            dcode = jnp.take(jnp.asarray(dc_code_t).reshape(-1), dci)
+            dlen = jnp.take(jnp.asarray(dc_len_t).reshape(-1), dci)
+            dc_b = jnp.stack([dcode, _vbits(diff, dsize)], axis=1)   # [M, 2]
+            dc_l_ = jnp.stack([dlen, dsize], axis=1)
 
-            # ---- AC run-lengths -------------------------------------------
-            z = stream[:, 1:]                                   # [M, 63]
+            # ---- AC symbols [M, 63] ---------------------------------------
+            z = stream[:, 1:]
             nzm = z != 0
             posk = jnp.arange(1, 64, dtype=jnp.int32)[None, :]
             p = jnp.where(nzm, posk, 0)
             m_incl = jax.lax.associative_scan(jnp.maximum, p, axis=1)
             prev_excl = jnp.concatenate(
-                [jnp.zeros((m_blocks, 1), jnp.int32), m_incl[:, :-1]], axis=1)
+                [jnp.zeros((M, 1), jnp.int32), m_incl[:, :-1]], axis=1)
             run = posk - prev_excl - 1
-            size = _bitlen(z)
+            size = _bitlen(z)                                    # ≤ 10
             rem = run & 15
-            nzrl = run >> 4                                     # 0..3
+            nzrl = run >> 4                                      # 0..3
 
-            ac_sym = ((rem << 4) | size)
-            acode = lut(ac_code, ac_sym)
-            alen = lut(ac_len, ac_sym)
-            main_bits = ((acode << size.astype(jnp.uint32))
-                         | _vbits(z, size)).astype(jnp.uint32)
-            main_len = jnp.where(nzm, alen + size, 0)
+            idx = chroma[:, None] * 256 + ((rem << 4) | size)
+            packed = _lut512(idx.reshape(-1)).reshape(M, 63)
+            acode = (packed >> 5).astype(jnp.uint32)
+            alen = packed & 31
 
-            zrl_code = jnp.where(chroma == 1, int(ac_code[1][0xF0]),
-                                 int(ac_code[0][0xF0]))[:, None]
-            zrl_len = jnp.where(chroma == 1, int(ac_len[1][0xF0]),
-                                int(ac_len[0][0xF0]))[:, None]
-            zrl_slots_bits = jnp.broadcast_to(
-                zrl_code[..., None], (m_blocks, 63, 3)).astype(jnp.uint32)
-            zrl_active = nzm[..., None] & (
-                nzrl[..., None] > jnp.arange(3)[None, None, :])
-            zrl_slots_len = jnp.where(zrl_active, zrl_len[..., None], 0)
+            zc = jnp.where(chroma == 1, zrl_c[1], zrl_c[0]).astype(jnp.uint32)[:, None]
+            zl = jnp.where(chroma == 1, zrl_l[1], zrl_l[0])[:, None]
 
-            # ---- EOB -------------------------------------------------------
-            eob_active = m_incl[:, -1] != 63
-            eob_bits = jnp.where(chroma == 1, int(ac_code[1][0x00]),
-                                 int(ac_code[0][0x00])).astype(jnp.uint32)
-            eob_len = jnp.where(
-                eob_active,
-                jnp.where(chroma == 1, int(ac_len[1][0x00]), int(ac_len[0][0x00])),
-                0)
+            # slot 0: first two ZRLs; slot 1: third ZRL ∥ code; slot 2: value
+            s0b = jnp.where(nzrl >= 2, (zc << zl.astype(jnp.uint32)) | zc,
+                            jnp.where(nzrl >= 1, zc, 0))
+            s0l = jnp.where(nzm, jnp.minimum(nzrl, 2) * zl, 0)
+            s1b = jnp.where(nzrl >= 3, (zc << alen.astype(jnp.uint32)) | acode, acode)
+            s1l = jnp.where(nzm, alen + jnp.where(nzrl >= 3, zl, 0), 0)
+            s2b = _vbits(z, size)
+            s2l = jnp.where(nzm, size, 0)
 
-            # ---- dense symbol grid [M, 254] -------------------------------
-            ac_slots_bits = jnp.concatenate(
-                [zrl_slots_bits, main_bits[..., None]], axis=2).reshape(m_blocks, 252)
-            ac_slots_len = jnp.concatenate(
-                [zrl_slots_len, main_len[..., None]], axis=2).reshape(m_blocks, 252)
-            bits_g = jnp.concatenate(
-                [dc_bits[:, None], ac_slots_bits, eob_bits[:, None]], axis=1)
-            lens_g = jnp.concatenate(
-                [dc_slen[:, None], ac_slots_len, eob_len[:, None]], axis=1)
+            # EOB folds into coefficient 63's (ZRL∥code) slot when the block
+            # doesn't end in a nonzero coefficient.
+            eob_on = m_incl[:, -1] != 63
+            ec = jnp.where(chroma == 1, eob_c[1], eob_c[0]).astype(jnp.uint32)
+            el = jnp.where(chroma == 1, eob_l[1], eob_l[0])
+            s1b = s1b.at[:, 62].set(
+                jnp.where(nzm[:, 62], s1b[:, 62], jnp.where(eob_on, ec, 0)))
+            s1l = s1l.at[:, 62].set(
+                jnp.where(nzm[:, 62], s1l[:, 62], jnp.where(eob_on, el, 0)))
 
-            flat_bits = bits_g.reshape(-1)
-            flat_len = lens_g.reshape(-1)
+            # ---- [M, 192] slot grid (emission order; last slot is padding)
+            ac_b = jnp.stack([s0b, s1b, s2b], axis=2).reshape(M, 189)
+            ac_l2 = jnp.stack([s0l, s1l, s2l], axis=2).reshape(M, 189)
+            bits = jnp.concatenate(
+                [dc_b.astype(jnp.uint32), ac_b, jnp.zeros((M, 1), jnp.uint32)], axis=1)
+            lens = jnp.concatenate(
+                [dc_l_, ac_l2, jnp.zeros((M, 1), jnp.int32)], axis=1)
 
-            # ---- per-stripe bit offsets (segmented cumsum) ----------------
-            cum = jnp.cumsum(flat_len)
-            seg_last = cum.reshape(n_stripes, syms_per_stripe)[:, -1]
-            stripe_end = seg_last                            # inclusive cumsum @ seg end
-            stripe_base = jnp.concatenate(
-                [jnp.zeros((1,), cum.dtype), stripe_end[:-1]])
-            stripe_of = (
-                jnp.arange(flat_len.shape[0], dtype=jnp.int32) // syms_per_stripe)
-            off = cum - flat_len - stripe_base[stripe_of]    # bit offset in stripe
-            t_bits = stripe_end - stripe_base                # [S]
+            # ---- intra-block pack into ≤W words ---------------------------
+            cum = jnp.cumsum(lens, axis=1)
+            off = cum - lens                                     # [M, SLOTS]
+            Lb = cum[:, -1]                                      # [M] ≥ 6
+            blk_ovf = Lb > 32 * W
 
-            # ---- stripe byte-alignment padding ----------------------------
+            j0 = jnp.minimum(off >> 5, W - 1)
+            pos = off & 31
+            sh = 32 - pos - lens
+            safe = jnp.where(lens > 0, bits, 0)
+            c0 = jnp.where(
+                sh >= 0,
+                safe << jnp.clip(sh, 0, 31).astype(jnp.uint32),
+                safe >> jnp.clip(-sh, 0, 31).astype(jnp.uint32)).astype(jnp.uint32)
+            c1 = jnp.where(
+                sh < 0, safe << jnp.clip(32 + sh, 0, 31).astype(jnp.uint32),
+                jnp.uint32(0)).astype(jnp.uint32)
+            j1 = jnp.minimum(j0 + 1, W - 1)
+
+            wk = jnp.arange(W, dtype=jnp.int32)[None, None, :]
+            words_blk = (
+                jnp.where(j0[..., None] == wk, c0[..., None], 0)
+                + jnp.where(j1[..., None] == wk, c1[..., None], 0)
+            ).sum(axis=1, dtype=jnp.uint32)                      # [M, W]
+
+            # ---- block bases within stripe --------------------------------
+            Lb2 = Lb.reshape(S, bps)
+            cumb = jnp.cumsum(Lb2, axis=1)
+            base = cumb - Lb2                                    # [S, bps] bits
+            t_bits = cumb[:, -1]
             pad = (-t_bits) % 8
             t_bytes = ((t_bits + pad) // 8).astype(jnp.int32)
 
-            # ---- word contributions ---------------------------------------
-            def contributions(offv, lenv, bitsv, stripev):
-                """Split each symbol into ≤2 word contributions (len ≤ 27 < 32)."""
-                word_in_stripe = jnp.minimum((offv >> 5), max_w - 1)
-                overflow = (offv + lenv) > (max_w * 32)
-                bitpos = (offv & 31).astype(jnp.int32)
-                shift = 32 - bitpos - lenv
-                safe = jnp.where((lenv > 0) & ~overflow, bitsv, 0)
-                c0 = jnp.where(
-                    shift >= 0,
-                    safe << jnp.maximum(shift, 0).astype(jnp.uint32),
-                    safe >> jnp.maximum(-shift, 0).astype(jnp.uint32),
-                ).astype(jnp.uint32)
-                c1 = jnp.where(
-                    shift >= 0, jnp.uint32(0),
-                    safe << jnp.maximum(32 + shift, 0).astype(jnp.uint32),
-                ).astype(jnp.uint32)
-                w0 = stripev * max_w + word_in_stripe
-                w1 = jnp.minimum(w0 + 1, n_stripes * max_w - 1)
-                return w0, c0, w1, c1
+            g0 = base >> 5                                       # [S, bps]
+            r = base & 31
+            e = (base + Lb2 - 1) >> 5                            # last word touched
 
-            n_words = n_stripes * max_w
-            w0, c0, w1, c1 = contributions(off, flat_len, flat_bits, stripe_of)
-            # Both streams are sorted (symbols are stripe-major with monotone
-            # offsets), so word values fall out of a wrapping cumsum
-            # differenced at word boundaries — no scatter.
-            words = (
-                _sorted_segment_words(w0, c0, n_words)
-                + _sorted_segment_words(w1, c1, n_words)
-            )
-            # The S padding symbols (one per stripe) are added by a tiny
-            # scatter instead of re-sorting 12M symbols around them.
-            pw0, pc0, pw1, pc1 = contributions(
-                t_bits, pad, ((1 << pad) - 1).astype(jnp.uint32),
-                jnp.arange(n_stripes, dtype=jnp.int32))
-            words = words.at[pw0].add(pc0).at[pw1].add(pc1)
+            # ---- globalize block words (analytic indices) -----------------
+            v = words_blk.reshape(S, bps, W)
+            r3 = r[..., None]
+            u0 = v >> r3.astype(jnp.uint32)
+            u1 = jnp.where(r3 == 0, jnp.uint32(0),
+                           v << (32 - r3).astype(jnp.uint32))
+            cs0 = jnp.cumsum(u0.reshape(S, bps * W), axis=1, dtype=jnp.uint32)
+            cs1 = jnp.cumsum(u1.reshape(S, bps * W), axis=1, dtype=jnp.uint32)
 
-            # ---- compaction ------------------------------------------------
-            # Per-stripe clamp: an overflowed stripe still occupies exactly
-            # max_w words so downstream stripes' offsets stay valid.
-            wc = jnp.minimum((t_bytes + 3) // 4, max_w)
+            # boundary block per output word: last block with g0 ≤ w
+            g0c = jnp.clip(g0, 0, V - 1)
+            srows = jnp.arange(S, dtype=jnp.int32)[:, None]
+            bidx = jnp.arange(bps, dtype=jnp.int32)[None, :]
+            lastblk = jnp.zeros((S, V), jnp.int32).at[srows, g0c].max(bidx)
+            lastblk = jax.lax.associative_scan(jnp.maximum, lastblk, axis=1)
+
+            # pack (g0, e) for one boundary gather: both < 2^15
+            ge = (jnp.clip(g0, 0, (1 << 15) - 1) << 16) | (
+                jnp.clip(e + 1, 0, (1 << 15) - 1))
+            ge_b = jnp.take_along_axis(ge, lastblk, axis=1)       # [S, V]
+            g0b = ge_b >> 16
+            e1b = ge_b & 0xFFFF                                   # e + 1
+            w_ar = jnp.arange(V, dtype=jnp.int32)[None, :]
+
+            jstar = jnp.where(e1b <= w_ar, W - 1,
+                              jnp.minimum(w_ar - g0b, W - 1))
+            s_at0 = jnp.take_along_axis(cs0, lastblk * W + jstar, axis=1)
+            word0 = s_at0 - jnp.concatenate(
+                [jnp.zeros((S, 1), jnp.uint32), s_at0[:, :-1]], axis=1)
+
+            # stream-1 boundary: last block with g0 ≤ w-1 (shift by one word)
+            lastblk1 = jnp.concatenate(
+                [jnp.zeros((S, 1), jnp.int32), lastblk[:, :-1]], axis=1)
+            ge_b1 = jnp.take_along_axis(ge, lastblk1, axis=1)
+            g0b1 = ge_b1 >> 16
+            e1b1 = ge_b1 & 0xFFFF
+            jstar1 = jnp.where(e1b1 + 1 <= w_ar, W - 1,
+                               jnp.clip(w_ar - 1 - g0b1, 0, W - 1))
+            s_at1 = jnp.take_along_axis(cs1, lastblk1 * W + jstar1, axis=1)
+            s_at1 = jnp.where(w_ar == 0, 0, s_at1)
+            word1 = s_at1 - jnp.concatenate(
+                [jnp.zeros((S, 1), jnp.uint32), s_at1[:, :-1]], axis=1)
+
+            words_stripe = word0 + word1                          # [S, V]
+
+            # ---- stripe byte-alignment padding (1-bits) -------------------
+            mask = ((1 << pad) - 1).astype(jnp.uint32)
+            ppos = t_bits & 31
+            psh = 32 - ppos - pad
+            pw = jnp.clip(t_bits >> 5, 0, V - 1)
+            pc0 = jnp.where(psh >= 0, mask << jnp.clip(psh, 0, 31).astype(jnp.uint32),
+                            mask >> jnp.clip(-psh, 0, 31).astype(jnp.uint32))
+            pc1 = jnp.where(psh < 0,
+                            mask << jnp.clip(32 + psh, 0, 31).astype(jnp.uint32),
+                            jnp.uint32(0))
+            srow = jnp.arange(S, dtype=jnp.int32)
+            words_stripe = words_stripe.at[srow, pw].add(pc0.astype(jnp.uint32))
+            words_stripe = words_stripe.at[srow, jnp.clip(pw + 1, 0, V - 1)].add(
+                pc1.astype(jnp.uint32))
+
+            # ---- compaction (stripes back-to-back, word aligned) ----------
+            wc = jnp.minimum((t_bytes + 3) // 4, V)
             base_words = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32), jnp.cumsum(wc)[:-1].astype(jnp.int32)])
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(wc)[:-1].astype(jnp.int32)])
             j = jnp.arange(cap_words, dtype=jnp.int32)
             sidx = jnp.clip(
-                jnp.searchsorted(base_words, j, side="right") - 1, 0, n_stripes - 1)
-            src = sidx * max_w + (j - base_words[sidx])
+                jnp.searchsorted(base_words, j, side="right") - 1, 0, S - 1)
+            src = sidx * V + jnp.clip(j - base_words[sidx], 0, V - 1)
             valid = j < (base_words[-1] + wc[-1])
-            src = jnp.clip(src, 0, n_words - 1)
-            compacted = jnp.where(valid, words[src], 0)
+            compacted = jnp.where(valid, words_stripe.reshape(-1)[src], 0)
 
-            stripe_overflow = t_bytes > (max_w * 4)
+            stripe_overflow = (t_bytes > V * 4) | blk_ovf.reshape(S, bps).any(axis=1)
             return compacted, t_bytes, base_words, stripe_overflow
 
         self._pack_fn = pack_fn

@@ -103,17 +103,45 @@ def _device_pipeline(pad_h: int, pad_w: int, stripe_h: int):
     shared event loop otherwise)."""
     from .device_entropy import DeviceEntropyPacker
 
-    packer = DeviceEntropyPacker(pad_h, pad_w, stripe_h)
+    # Streaming fast path: 16-word (512-bit) per-block budget. Blocks beyond
+    # it (dense high-quality content) flag their stripe, which falls back to
+    # the host coder in _scans_from_packed — output stays bit-exact.
+    packer = DeviceEntropyPacker(pad_h, pad_w, stripe_h, block_words=16)
     packer_fn = packer._pack_fn
+    n_stripes = pad_h // stripe_h
 
     @functools.partial(jax.jit, donate_argnames=("prev",))
     def step(frame, prev, qy, qc, qsel):
         yq, cbq, crq, damage, new_prev = _encode_body(
             frame, prev, qy, qc, qsel, stripe_h=stripe_h)
         words, nbytes, base, ovf = packer_fn(yq, cbq, crq)
-        return words, nbytes, base, ovf, damage, new_prev, yq, cbq, crq
+        # One fetchable buffer per frame: 4*S words of metadata followed by
+        # the packed bitstream. Tunneled/RPC transports pay ~25-100 ms per
+        # transfer regardless of size, so the host must be able to harvest a
+        # frame with a single D2H read (see pipeline.PipelinedJpegEncoder).
+        head = jnp.concatenate([
+            nbytes.astype(jnp.uint32),
+            base.astype(jnp.uint32),
+            ovf.astype(jnp.uint32),
+            damage.astype(jnp.uint32),
+        ])
+        packed = jnp.concatenate([head, words])
+        return packed, new_prev, yq, cbq, crq
 
     return packer, step
+
+
+META_WORDS_PER_STRIPE = 4  # nbytes, base_words, overflow, damage
+
+
+def split_meta(head_np: np.ndarray, n_stripes: int):
+    """Parse the 4*S metadata words at the front of a packed step buffer."""
+    s = n_stripes
+    nbytes = head_np[0:s].astype(np.int64)
+    base = head_np[s:2 * s].astype(np.int64)
+    ovf = head_np[2 * s:3 * s] != 0
+    damage = head_np[3 * s:4 * s].astype(np.int64)
+    return nbytes, base, ovf, damage
 
 
 def _entropy_encode_420(y: np.ndarray, cb: np.ndarray, cr: np.ndarray) -> bytes:
@@ -269,11 +297,6 @@ class JpegStripeEncoder:
             )
         return out
 
-    def _fetch_bucket(self, words, total_words: int):
-        """Fetch a power-of-two slice of the packed word buffer (each distinct
-        slice shape compiles once; bucketing bounds the executable count)."""
-        return np.asarray(words[:self._packer.bucket_words(total_words)])
-
     @staticmethod
     def total_packed_words(base_np: np.ndarray, nbytes_np: np.ndarray) -> int:
         """Packed-word count of the whole frame (last stripe's base + span)."""
@@ -310,17 +333,20 @@ class JpegStripeEncoder:
         crows = self.stripe_h // 16
 
         if self.entropy == "device":
-            words, nbytes, base, ovf, damage, new_prev, yq, cbq, crq = self._step(
+            packed, new_prev, yq, cbq, crq = self._step(
                 jnp.asarray(frame), self._prev, self._qy, self._qc, qsel)
             self._prev = new_prev
-            nbytes_np, base_np, damage_np, ovf_np = (
-                np.asarray(a) for a in (nbytes, base, damage, ovf))
+            mw = META_WORDS_PER_STRIPE * self.n_stripes
+            head_np = np.asarray(packed[:mw])
+            nbytes_np, base_np, ovf_np, damage_np = split_meta(
+                head_np, self.n_stripes)
             emit, is_paint = self._decide_emits(
                 damage_np > self.damage_threshold, paint_candidate)
             scans: List[bytes] = [b""] * self.n_stripes
             if emit.any():
-                words_np = self._fetch_bucket(
-                    words, self.total_packed_words(base_np, nbytes_np))
+                total = self.total_packed_words(base_np, nbytes_np)
+                bucket = self._packer.bucket_words(total)
+                words_np = np.asarray(packed[mw:mw + bucket])
                 scans = self._scans_from_packed(
                     words_np, base_np, nbytes_np, ovf_np, emit, yq, cbq, crq)
             return self._assemble(emit, is_paint, scans)

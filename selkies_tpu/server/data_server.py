@@ -406,10 +406,10 @@ class DataStreamingServer:
         selkies.py:2616): stop every capture, re-arrange the X screen, then
         restart active pipelines with their new geometry/offsets.  Captures
         stop FIRST so no XGetImage ever races a shrinking root window."""
-        for st in self.display_clients.values():
+        for st in list(self.display_clients.values()):
             await self._stop_display(st)
         await self._apply_x11_layout()
-        for st in self.display_clients.values():
+        for st in list(self.display_clients.values()):
             if st.video_active and st.ws is not None:
                 await self._start_display(st)
 

@@ -1,7 +1,6 @@
 """Display plane: GTF modelines, layout geometry, xrandr command grammar,
 DPI fan-out (reference parity: selkies.py:216-470, 2616-2779)."""
 
-import numpy as np
 import pytest
 
 from selkies_tpu.display import (DpiManager, XrandrManager, compute_layout,
