@@ -1,0 +1,42 @@
+"""WebRTC session plumbing: TURN/STUN credential management, RTC config
+monitors, the turn-rest credential microservice, and the combined
+HTTP + WebSocket signaling server/client.
+
+Parity targets (reference, read-only):
+  - ``legacy/signalling_web.py`` — signaling + web server
+  - ``legacy/webrtc_signalling.py`` — in-process signaling client
+  - ``legacy/webrtc.py:62-328`` — RTC config monitors + fetchers
+  - ``addons/turn-rest/app.py`` — HMAC credential REST service
+"""
+
+from .turn import (
+    DEFAULT_RTC_CONFIG,
+    TurnCredentials,
+    build_rtc_config,
+    fetch_cloudflare_turn,
+    fetch_turn_rest,
+    generate_rtc_config,
+    hmac_credentials,
+    parse_rtc_config,
+)
+from .monitors import HMACRTCMonitor, RESTRTCMonitor, RTCConfigFileMonitor
+from .signaling import SignalingServer
+from .signaling_client import SignalingClient, SignalingError, SignalingNoPeerError
+
+__all__ = [
+    "DEFAULT_RTC_CONFIG",
+    "TurnCredentials",
+    "build_rtc_config",
+    "fetch_cloudflare_turn",
+    "fetch_turn_rest",
+    "generate_rtc_config",
+    "hmac_credentials",
+    "parse_rtc_config",
+    "HMACRTCMonitor",
+    "RESTRTCMonitor",
+    "RTCConfigFileMonitor",
+    "SignalingServer",
+    "SignalingClient",
+    "SignalingError",
+    "SignalingNoPeerError",
+]
