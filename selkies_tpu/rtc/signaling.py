@@ -109,9 +109,12 @@ class SignalingServer:
             user, pw = base64.b64decode(auth.split(None, 1)[1]).decode().split(":", 1)
         except Exception:
             return False
-        return user == self.basic_auth_user and pw == self.basic_auth_password
+        import hmac as hmac_mod
 
-    def process_request(self, connection, request):
+        return hmac_mod.compare_digest(user, self.basic_auth_user) \
+            & hmac_mod.compare_digest(pw, self.basic_auth_password)
+
+    async def process_request(self, connection, request):
         path = request.path
         if self.enable_basic_auth and not self._check_basic_auth(request):
             hdrs = Headers()
@@ -128,7 +131,9 @@ class SignalingServer:
         if path.rstrip("/") == "/turn":
             return self._turn_response(request)
 
-        return self._static_response(path)
+        # disk I/O off the event loop: a big asset read must not stall
+        # concurrent SDP/ICE relays
+        return await asyncio.to_thread(self._static_response, path)
 
     def _turn_response(self, request) -> Response:
         hdrs = Headers()
@@ -225,10 +230,18 @@ class SignalingServer:
     async def _handle_peer(self, ws, uid: str) -> None:
         while True:
             msg = await self._recv_with_keepalive(ws)
-            status = self.peers[uid][1]
+            if not isinstance(msg, str):
+                await ws.send("ERROR binary frames not supported")
+                continue
+            entry = self.peers.get(uid)
+            if entry is None:  # partner teardown removed us mid-flight
+                return
+            status = entry[1]
             if status == "session":
-                other = self.sessions[uid]
-                await self.peers[other][0].send(msg)
+                other = self.sessions.get(uid)
+                peer = self.peers.get(other) if other is not None else None
+                if peer is not None:
+                    await peer[0].send(msg)
             elif status is not None:  # in a room
                 if msg.startswith("ROOM_PEER_MSG"):
                     try:
@@ -243,7 +256,11 @@ class SignalingServer:
                 else:
                     await ws.send("ERROR invalid msg, already in room")
             elif msg.startswith("SESSION"):
-                _, callee = msg.split(maxsplit=1)
+                try:
+                    _, callee = msg.split(maxsplit=1)
+                except ValueError:
+                    await ws.send("ERROR invalid SESSION command")
+                    continue
                 if callee not in self.peers:
                     await ws.send(f"ERROR peer {callee!r} not found")
                     continue
@@ -254,23 +271,32 @@ class SignalingServer:
                 meta64 = (
                     base64.b64encode(json.dumps(meta).encode()).decode() if meta else ""
                 )
-                await ws.send(f"SESSION_OK {meta64}".rstrip())
+                # register the session before the await so a concurrent
+                # SESSION to either peer sees them as busy
                 self.peers[uid][1] = "session"
                 self.peers[callee][1] = "session"
                 self.sessions[uid] = callee
                 self.sessions[callee] = uid
+                await ws.send(f"SESSION_OK {meta64}".rstrip())
             elif msg.startswith("ROOM"):
-                _, room_id = msg.split(maxsplit=1)
+                try:
+                    _, room_id = msg.split(maxsplit=1)
+                except ValueError:
+                    await ws.send("ERROR invalid ROOM command")
+                    continue
                 if room_id == "session" or room_id.split() != [room_id]:
                     await ws.send(f"ERROR invalid room id {room_id!r}")
                     continue
                 members = self.rooms.setdefault(room_id, set())
-                await ws.send(("ROOM_OK " + " ".join(members)).rstrip())
-                self.peers[uid][1] = room_id
+                # join before the first await so concurrent joiners see us
+                existing = sorted(members)
                 members.add(uid)
-                for pid in members:
-                    if pid != uid:
-                        await self.peers[pid][0].send(f"ROOM_PEER_JOINED {uid}")
+                self.peers[uid][1] = room_id
+                await ws.send(("ROOM_OK " + " ".join(existing)).rstrip())
+                for pid in existing:
+                    peer = self.peers.get(pid)
+                    if peer is not None:
+                        await peer[0].send(f"ROOM_PEER_JOINED {uid}")
             else:
                 logger.info("ignoring unknown message %r from %r", msg, uid)
 

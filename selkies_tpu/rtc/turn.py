@@ -151,10 +151,11 @@ def fetch_turn_rest(
         http.client.HTTPSConnection if parsed.scheme == "https" else http.client.HTTPConnection
     )
     conn = conn_cls(parsed.netloc, timeout=timeout)
+    request_path = (parsed.path or "/") + (f"?{parsed.query}" if parsed.query else "")
     try:
         conn.request(
             "GET",
-            parsed.path or "/",
+            request_path,
             headers={
                 auth_header_username: user,
                 header_protocol: protocol,

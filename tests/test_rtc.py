@@ -151,12 +151,6 @@ def test_turn_rest_service():
 # ------------------------------------------------------------------ signaling
 
 
-@pytest.fixture
-def sig_server_port(tmp_path):
-    """Runs a SignalingServer on an ephemeral port inside each test's loop."""
-    return None  # placeholder: tests start their own server
-
-
 def _start_server(**kwargs):
     server = SignalingServer(addr="127.0.0.1", port=0, **kwargs)
     task = asyncio.create_task(server.run())

@@ -1,0 +1,164 @@
+"""STUN codec, ICE loopback connectivity, and SDP round-trip tests."""
+
+import asyncio
+
+import pytest
+
+from selkies_tpu.webrtc import stun
+from selkies_tpu.webrtc.ice import Candidate, IceAgent
+from selkies_tpu.webrtc.sdp import (MediaSection, SessionDescription,
+                                    default_audio_codecs,
+                                    default_video_codecs)
+
+
+# ------------------------------------------------------------------ STUN
+
+
+def test_stun_roundtrip_with_integrity_and_fingerprint():
+    msg = stun.StunMessage(method=stun.BINDING, msg_class=stun.CLASS_REQUEST)
+    msg.set_username("remote:local")
+    msg.attributes[stun.ATTR_PRIORITY] = (12345).to_bytes(4, "big")
+    data = msg.serialize(integrity_key=b"swordfish")
+    assert stun.is_stun(data)
+    parsed = stun.StunMessage.parse(data)
+    assert parsed.method == stun.BINDING
+    assert parsed.msg_class == stun.CLASS_REQUEST
+    assert parsed.username() == "remote:local"
+    assert parsed.verify_integrity(b"swordfish")
+    assert not parsed.verify_integrity(b"wrong")
+
+
+def test_stun_xor_mapped_address():
+    msg = stun.StunMessage(msg_class=stun.CLASS_SUCCESS)
+    msg.set_xor_mapped_address(("192.0.2.1", 32853))
+    got = stun.StunMessage.parse(msg.serialize()).xor_mapped_address()
+    assert got == ("192.0.2.1", 32853)
+
+
+def test_stun_error_attr():
+    msg = stun.StunMessage(msg_class=stun.CLASS_ERROR)
+    msg.set_error(401, "Unauthorized")
+    code, reason = stun.StunMessage.parse(msg.serialize()).error()
+    assert code == 401 and reason == "Unauthorized"
+
+
+def test_stun_rejects_rtp():
+    from selkies_tpu.webrtc.rtp import RtpPacket
+    assert not stun.is_stun(RtpPacket(payload_type=96).serialize())
+
+
+def test_message_type_interleave():
+    # binding success response is 0x0101 on the wire
+    assert stun.message_type(stun.BINDING, stun.CLASS_SUCCESS) == 0x0101
+    assert stun.split_type(0x0101) == (stun.BINDING, stun.CLASS_SUCCESS)
+    assert stun.message_type(stun.BINDING, stun.CLASS_REQUEST) == 0x0001
+
+
+# ------------------------------------------------------------------ ICE
+
+
+def test_ice_loopback_connect_and_data():
+    async def run():
+        a = IceAgent(controlling=True, interfaces=["127.0.0.1"])
+        b = IceAgent(controlling=False, interfaces=["127.0.0.1"])
+        await a.gather()
+        await b.gather()
+        assert a.local_candidates and b.local_candidates
+
+        a.set_remote_credentials(b.local_ufrag, b.local_pwd)
+        b.set_remote_credentials(a.local_ufrag, a.local_pwd)
+        for c in b.local_candidates:
+            a.add_remote_candidate(c)
+        for c in a.local_candidates:
+            b.add_remote_candidate(c)
+
+        got_b = asyncio.get_running_loop().create_future()
+        got_a = asyncio.get_running_loop().create_future()
+        b.on_data = lambda d: got_b.done() or got_b.set_result(d)
+        a.on_data = lambda d: got_a.done() or got_a.set_result(d)
+
+        await asyncio.gather(a.connect(timeout=5), b.connect(timeout=5))
+        assert a.selected_pair is not None and b.selected_pair is not None
+
+        a.send(b"ping-from-a")
+        assert await asyncio.wait_for(got_b, 2) == b"ping-from-a"
+        b.send(b"pong-from-b")
+        assert await asyncio.wait_for(got_a, 2) == b"pong-from-b"
+
+        await a.close()
+        await b.close()
+
+    asyncio.run(run())
+
+
+def test_candidate_sdp_roundtrip():
+    c = Candidate("abcd1234", 1, "udp", 2130706431, "10.0.0.5", 9999, "host")
+    line = c.to_sdp()
+    assert Candidate.from_sdp(line) == c
+    assert Candidate.from_sdp("a=" + line) == c
+
+
+# ------------------------------------------------------------------ SDP
+
+
+def test_sdp_offer_roundtrip():
+    offer = SessionDescription(
+        session_id=4242,
+        bundle=["0", "1", "2"],
+        media=[
+            MediaSection(
+                kind="video", mid="0", codecs=default_video_codecs(),
+                ssrc=1111, cname="selkies", msid="stream track-v",
+                ice_ufrag="uf", ice_pwd="pw",
+                dtls_fingerprint="sha-256 AA:BB", dtls_setup="actpass",
+                extmap={2: "http://www.ietf.org/id/draft-holmer-rmcat-"
+                           "transport-wide-cc-extensions-01"},
+                candidates=[Candidate("f", 1, "udp", 1, "1.2.3.4", 5, "host")],
+            ),
+            MediaSection(kind="audio", mid="1",
+                         codecs=default_audio_codecs(), ssrc=2222),
+            MediaSection(kind="application", mid="2", sctp_port=5000,
+                         protocol="UDP/DTLS/SCTP", max_message_size=262144),
+        ])
+    text = offer.serialize()
+    got = SessionDescription.parse(text)
+    assert got.session_id == 4242
+    assert got.bundle == ["0", "1", "2"]
+    assert [m.kind for m in got.media] == ["video", "audio", "application"]
+
+    v = got.media[0]
+    assert v.codecs[0].name == "H264"
+    assert v.codecs[0].payload_type == 102
+    assert "packetization-mode=1" in v.codecs[0].fmtp
+    assert "nack pli" in v.codecs[0].rtcp_fb
+    assert v.ssrc == 1111 and v.msid == "stream track-v"
+    assert v.ice_ufrag == "uf" and v.dtls_setup == "actpass"
+    assert v.extmap[2].endswith("transport-wide-cc-extensions-01")
+    assert len(v.candidates) == 1 and v.candidates[0].port == 5
+
+    a = got.media[1]
+    assert a.codecs[0].name == "opus" and a.codecs[0].channels == 2
+
+    d = got.media[2]
+    assert d.sctp_port == 5000 and d.max_message_size == 262144
+
+
+def test_sdp_parses_browser_style_offer():
+    text = (
+        "v=0\r\no=- 77 2 IN IP4 127.0.0.1\r\ns=-\r\nt=0 0\r\n"
+        "a=group:BUNDLE 0\r\n"
+        "m=video 9 UDP/TLS/RTP/SAVPF 96 97\r\n"
+        "c=IN IP4 0.0.0.0\r\n"
+        "a=mid:0\r\na=sendrecv\r\na=rtcp-mux\r\n"
+        "a=ice-ufrag:x7Zy\r\na=ice-pwd:abcdefghijklmnopqrstuv\r\n"
+        "a=setup:active\r\n"
+        "a=rtpmap:96 VP8/90000\r\n"
+        "a=rtpmap:97 H264/90000\r\n"
+        "a=fmtp:97 packetization-mode=1\r\n"
+        "a=candidate:1 1 UDP 2130706431 192.168.1.4 50000 typ host\r\n")
+    got = SessionDescription.parse(text)
+    m = got.media[0]
+    assert [c.name for c in m.codecs] == ["VP8", "H264"]
+    assert m.codecs[1].fmtp == "packetization-mode=1"
+    assert m.candidates[0].host == "192.168.1.4"
+    assert m.dtls_setup == "active"

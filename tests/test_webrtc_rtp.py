@@ -1,0 +1,268 @@
+"""RTP/RTCP codec, H.264/Opus payloader, jitter buffer, and GCC tests
+(parity targets: vendored aiortc stack, SURVEY.md §2.4; GCC element,
+legacy/gstwebrtc_app.py:1555)."""
+
+import struct
+
+import pytest
+
+from selkies_tpu.webrtc.h264 import (H264Depayloader, H264Payloader,
+                                     split_annexb)
+from selkies_tpu.webrtc.jitterbuffer import JitterBuffer
+from selkies_tpu.webrtc.opus import OpusDepayloader, OpusPayloader
+from selkies_tpu.webrtc.rate import (DelayBasedEstimator, GccEstimator,
+                                     LossBasedEstimator)
+from selkies_tpu.webrtc.rtp import (RtcpBye, RtcpNack, RtcpPli,
+                                    RtcpReceiverReport, RtcpRemb, RtcpSdes,
+                                    RtcpSenderReport, RtcpTwcc, ReceiverReport,
+                                    RtpPacket, is_rtcp, parse_rtcp,
+                                    pack_abs_send_time, pack_playout_delay,
+                                    unpack_abs_send_time, unwrap_seq)
+
+
+# ------------------------------------------------------------------ RTP
+
+
+def test_rtp_roundtrip_basic():
+    p = RtpPacket(payload_type=96, sequence_number=1234, timestamp=567890,
+                  ssrc=0xDEADBEEF, payload=b"hello", marker=1)
+    q = RtpPacket.parse(p.serialize())
+    assert (q.payload_type, q.sequence_number, q.timestamp, q.ssrc,
+            q.payload, q.marker) == (96, 1234, 567890, 0xDEADBEEF, b"hello", 1)
+
+
+def test_rtp_roundtrip_extensions_and_csrc():
+    p = RtpPacket(payload_type=111, sequence_number=7, timestamp=1,
+                  ssrc=42, payload=b"x" * 100, csrc=[1, 2],
+                  extensions={3: pack_abs_send_time(12.5),
+                              5: struct.pack("!H", 999)})
+    q = RtpPacket.parse(p.serialize())
+    assert q.csrc == [1, 2]
+    assert abs(unpack_abs_send_time(q.extensions[3]) - 12.5) < 1e-4
+    assert struct.unpack("!H", q.extensions[5])[0] == 999
+    assert q.payload == b"x" * 100
+
+
+def test_rtp_padding():
+    p = RtpPacket(payload_type=0, payload=b"abc", padding=4)
+    q = RtpPacket.parse(p.serialize())
+    assert q.payload == b"abc" and q.padding == 4
+
+
+def test_unwrap_seq():
+    assert unwrap_seq(-1, 5) == 5
+    assert unwrap_seq(65534, 1) == 65537
+    assert unwrap_seq(65537, 65534) == 65534
+    assert unwrap_seq(100, 99) == 99
+
+
+def test_playout_delay_zero():
+    assert pack_playout_delay(0, 0) == b"\x00\x00\x00"
+
+
+# ------------------------------------------------------------------ RTCP
+
+
+def test_rtcp_sr_rr_roundtrip():
+    rr = ReceiverReport(ssrc=7, fraction_lost=12, packets_lost=-3,
+                        highest_sequence=70000, jitter=55, lsr=1, dlsr=2)
+    sr = RtcpSenderReport(ssrc=99, ntp_time=0x0102030405060708,
+                          rtp_time=12345, packet_count=10, octet_count=999,
+                          reports=[rr])
+    out = parse_rtcp(sr.serialize())
+    assert len(out) == 1
+    got = out[0]
+    assert isinstance(got, RtcpSenderReport)
+    assert got.ntp_time == 0x0102030405060708
+    assert got.reports[0].packets_lost == -3
+    assert got.reports[0].fraction_lost == 12
+
+    rrp = RtcpReceiverReport(ssrc=1, reports=[rr])
+    got = parse_rtcp(rrp.serialize())[0]
+    assert isinstance(got, RtcpReceiverReport)
+    assert got.reports[0].highest_sequence == 70000
+
+
+def test_rtcp_compound_and_demux():
+    sr = RtcpSenderReport(ssrc=9).serialize()
+    sdes = RtcpSdes(items=[(9, "user@host")]).serialize()
+    bye = RtcpBye(sources=[9]).serialize()
+    compound = sr + sdes + bye
+    assert is_rtcp(compound)
+    pkts = parse_rtcp(compound)
+    assert [type(p).__name__ for p in pkts] == [
+        "RtcpSenderReport", "RtcpSdes", "RtcpBye"]
+    assert pkts[1].items[0][1] == "user@host"
+    media = RtpPacket(payload_type=96, payload=b"z").serialize()
+    assert not is_rtcp(media)
+
+
+def test_rtcp_nack_blp():
+    n = RtcpNack(sender_ssrc=1, media_ssrc=2, lost=[100, 101, 110, 200])
+    got = parse_rtcp(n.serialize())[0]
+    assert isinstance(got, RtcpNack)
+    assert set(got.lost) == {100, 101, 110, 200}
+
+
+def test_rtcp_pli_fir():
+    pli = RtcpPli(sender_ssrc=5, media_ssrc=6)
+    got = parse_rtcp(pli.serialize())[0]
+    assert isinstance(got, RtcpPli)
+    assert (got.sender_ssrc, got.media_ssrc) == (5, 6)
+
+
+def test_rtcp_remb_roundtrip():
+    for rate in (150_000, 2_500_000, 25_000_000):
+        r = RtcpRemb(sender_ssrc=3, bitrate=rate, ssrcs=[10, 11])
+        got = parse_rtcp(r.serialize())[0]
+        assert isinstance(got, RtcpRemb)
+        assert got.ssrcs == [10, 11]
+        assert abs(got.bitrate - rate) / rate < 0.01
+
+
+def test_rtcp_twcc_roundtrip():
+    base_us = 64000 * 100
+    received = [(100, base_us), (101, base_us + 250), (102, None),
+                (103, base_us + 10_000)]
+    t = RtcpTwcc(sender_ssrc=1, media_ssrc=2, base_seq=100, fb_count=7,
+                 ref_time=100, received=received)
+    got = parse_rtcp(t.serialize())[0]
+    assert isinstance(got, RtcpTwcc)
+    assert got.base_seq == 100 and got.fb_count == 7
+    seqs = [s for s, _ in got.received]
+    assert seqs == [100, 101, 102, 103]
+    assert got.received[2][1] is None
+    assert got.received[0][1] == base_us
+    assert abs(got.received[3][1] - (base_us + 10_000)) < 250
+
+
+# ------------------------------------------------------------------ H264
+
+
+def make_au():
+    sps = bytes([0x67, 1, 2, 3])
+    pps = bytes([0x68, 4, 5])
+    idr = bytes([0x65]) + bytes(range(256)) * 20  # 5121 bytes
+    return b"\x00\x00\x00\x01" + sps + b"\x00\x00\x01" + pps \
+        + b"\x00\x00\x00\x01" + idr, [sps, pps, idr]
+
+
+def test_split_annexb():
+    au, nals = make_au()
+    assert split_annexb(au) == nals
+
+
+def test_h264_payload_roundtrip():
+    au, nals = make_au()
+    pay = H264Payloader(mtu=1200)
+    pkts = pay.packetize(au, ssrc=1, payload_type=102,
+                         sequence_number=10, timestamp=3000)
+    assert pkts[-1].marker == 1
+    assert all(len(p.payload) <= 1200 for p in pkts)
+    assert len({p.timestamp for p in pkts}) == 1
+    depay = H264Depayloader()
+    out = None
+    for p in pkts:
+        got = depay.feed(p)
+        if got is not None:
+            out = got
+    assert out is not None
+    assert split_annexb(out) == nals
+
+
+def test_h264_fua_mid_loss_drops_only_fragmented_nal():
+    au, nals = make_au()
+    pay = H264Payloader(mtu=500)
+    pkts = pay.packetize(au, ssrc=1, payload_type=102,
+                         sequence_number=0, timestamp=0)
+    # drop one middle FU-A fragment
+    fua = [i for i, p in enumerate(pkts) if p.payload[0] & 0x1F == 28]
+    assert len(fua) >= 3
+    del pkts[fua[1]]
+    depay = H264Depayloader()
+    out = None
+    for p in pkts:
+        got = depay.feed(p)
+        if got is not None:
+            out = got
+    # corrupted large NAL is present-but-damaged or absent; SPS/PPS survive
+    assert out is not None
+    recovered = split_annexb(out)
+    assert nals[0] in recovered and nals[1] in recovered
+
+
+def test_opus_payloader():
+    pay = OpusPayloader()
+    pkts = pay.packetize(b"opusframe", ssrc=2, payload_type=111,
+                         sequence_number=1, timestamp=960)
+    assert len(pkts) == 1
+    assert OpusDepayloader().feed(pkts[0]) == b"opusframe"
+
+
+# ------------------------------------------------------------------ jitter
+
+
+def test_jitterbuffer_reorder_and_missing():
+    jb = JitterBuffer()
+    mk = lambda s: RtpPacket(sequence_number=s, payload=bytes([s & 0xFF]))
+    assert [p.sequence_number for p in jb.add(mk(10))] == [10]
+    assert jb.add(mk(12)) == []
+    assert jb.missing() == [11]
+    out = jb.add(mk(11))
+    assert [p.sequence_number for p in out] == [11, 12]
+    assert jb.missing() == []
+
+
+def test_jitterbuffer_wraparound():
+    jb = JitterBuffer()
+    jb.add(RtpPacket(sequence_number=65535))
+    out = jb.add(RtpPacket(sequence_number=0))
+    assert [p.sequence_number for p in out] == [0]
+
+
+def test_jitterbuffer_late_packet_ignored():
+    jb = JitterBuffer()
+    jb.add(RtpPacket(sequence_number=5))
+    jb.add(RtpPacket(sequence_number=6))
+    assert jb.add(RtpPacket(sequence_number=5)) == []
+
+
+# ------------------------------------------------------------------ GCC
+
+
+def test_delay_estimator_grows_when_uncongested():
+    est = DelayBasedEstimator(start_bitrate=1_000_000)
+    t = 0.0
+    for i in range(500):
+        # send and receive in lockstep: no queuing delay
+        est.add_packet(send_ms=t, arrival_ms=t + 20.0, size=1200)
+        t += 6.0
+    assert est.bitrate > 1_000_000
+
+
+def test_delay_estimator_backs_off_under_congestion():
+    est = DelayBasedEstimator(start_bitrate=5_000_000)
+    t = 0.0
+    queue = 0.0
+    for i in range(600):
+        queue += 1.2   # queue grows 1.2 ms per packet: persistent overuse
+        est.add_packet(send_ms=t, arrival_ms=t + 20.0 + queue, size=1200)
+        t += 6.0
+    assert est.bitrate < 5_000_000
+
+
+def test_loss_estimator():
+    l = LossBasedEstimator(1_000_000)
+    for _ in range(10):
+        l.update(0.0)
+    grown = l.bitrate
+    assert grown > 1_000_000
+    for _ in range(10):
+        l.update(0.5)
+    assert l.bitrate < grown
+
+
+def test_gcc_combined_takes_min():
+    g = GccEstimator(2_000_000)
+    g.add_loss_report(0.5)
+    assert g.bitrate == g.loss.bitrate < 2_000_000
