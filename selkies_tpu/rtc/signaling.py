@@ -111,8 +111,10 @@ class SignalingServer:
             return False
         import hmac as hmac_mod
 
-        return hmac_mod.compare_digest(user, self.basic_auth_user) \
-            & hmac_mod.compare_digest(pw, self.basic_auth_password)
+        return hmac_mod.compare_digest(
+            user.encode(), self.basic_auth_user.encode()) \
+            & hmac_mod.compare_digest(
+                pw.encode(), self.basic_auth_password.encode())
 
     async def process_request(self, connection, request):
         path = request.path

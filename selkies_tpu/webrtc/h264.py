@@ -141,11 +141,18 @@ class H264Depayloader:
     def __init__(self):
         self._nals: List[bytes] = []
         self._fu: Optional[_FuState] = None
+        self._last_seq: Optional[int] = None
 
     def feed(self, packet: RtpPacket) -> Optional[bytes]:
         p = packet.payload
         if not p:
             return None
+        # a sequence gap invalidates any FU-A reassembly in progress —
+        # emitting a spliced NAL would hand the decoder corrupt slices
+        if self._last_seq is not None and \
+                packet.sequence_number != (self._last_seq + 1) & 0xFFFF:
+            self._fu = None
+        self._last_seq = packet.sequence_number
         ntype = p[0] & 0x1F
         if ntype == NAL_STAP_A:
             pos = 1

@@ -231,7 +231,7 @@ class IceAgent:
     async def _check_loop(self) -> None:
         while not self._connected_evt.is_set() and not self._closed:
             for pair in list(self._pairs):
-                if pair.state in ("succeeded", "failed"):
+                if pair.state in ("succeeded", "failed", "inprogress"):
                     continue
                 pair.state = "inprogress"
                 asyncio.ensure_future(self._check_pair(pair))

@@ -51,9 +51,7 @@ class JitterBuffer:
         self._packets[seq] = packet
         if len(self._packets) > self.capacity:
             # overflow: jump the release head to the oldest held packet
-            oldest = min(self._packets)
-            while self._next < oldest:
-                self._next += 1
+            self._next = max(self._next, min(self._packets))
         out: List[RtpPacket] = []
         while self._next in self._packets:
             out.append(self._packets.pop(self._next))

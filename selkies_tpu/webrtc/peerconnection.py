@@ -1,0 +1,435 @@
+"""PeerConnection: JSEP orchestration of ICE + DTLS-SRTP + RTP + SCTP.
+
+Role parity with the vendored ``webrtc/rtcpeerconnection.py`` (SURVEY.md
+§2.4), scoped to what the streaming platform needs: a sendrecv video
+track carrying externally-encoded H.264 (tpuenc bitstream — never
+re-encoded), an Opus audio track, and DCEP data channels for the input
+plane. Bundle-only (one transport for everything), rtcp-mux, DTLS role
+from SDP ``a=setup``, ICE role from offerer-ship.
+
+Demux on the single socket follows RFC 7983: STUN is consumed inside the
+IceAgent; first byte 20-63 → DTLS records (handshake + SCTP app data);
+128-191 → SRTP/SRTCP (split by RTCP packet-type range).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import struct
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .dtls import DtlsCertificate, DtlsEndpoint
+from .h264 import H264Depayloader, H264Payloader
+from .ice import Candidate, IceAgent
+from .jitterbuffer import JitterBuffer
+from .opus import OpusDepayloader, OpusPayloader
+from .rate import GccEstimator
+from .rtp import (RtcpNack, RtcpPli, RtcpReceiverReport, RtcpSenderReport,
+                  RtpPacket, is_rtcp, parse_rtcp)
+from .sctp import DataChannel, SctpAssociation
+from .sdp import (MediaSection, SessionDescription, default_audio_codecs,
+                  default_video_codecs)
+from .srtp import SrtpContext, srtp_pair_from_dtls
+
+logger = logging.getLogger("selkies_tpu.webrtc.pc")
+
+VIDEO_PT = 102
+AUDIO_PT = 111
+VIDEO_CLOCK = 90000
+
+
+class MediaSender:
+    """One outbound RTP stream (externally encoded payloads in)."""
+
+    def __init__(self, pc: "PeerConnection", kind: str, ssrc: int,
+                 payload_type: int, clock_rate: int):
+        self.pc = pc
+        self.kind = kind
+        self.ssrc = ssrc
+        self.payload_type = payload_type
+        self.clock_rate = clock_rate
+        self.sequence = struct.unpack("!H", os.urandom(2))[0]
+        self.packet_count = 0
+        self.octet_count = 0
+        self._payloader = H264Payloader() if kind == "video" \
+            else OpusPayloader()
+
+    def send_frame(self, payload: bytes, timestamp: int) -> None:
+        """Packetize + protect + ship one encoded frame/AU."""
+        packets = self._payloader.packetize(
+            payload, self.ssrc, self.payload_type, self.sequence, timestamp)
+        self.sequence = (self.sequence + len(packets)) & 0xFFFF
+        for pkt in packets:
+            raw = pkt.serialize()
+            self.packet_count += 1
+            self.octet_count += len(pkt.payload)
+            self.pc._send_rtp(raw)
+
+    def sender_report(self, ntp_time: int, rtp_time: int) -> RtcpSenderReport:
+        return RtcpSenderReport(
+            ssrc=self.ssrc, ntp_time=ntp_time, rtp_time=rtp_time,
+            packet_count=self.packet_count, octet_count=self.octet_count)
+
+
+class MediaReceiver:
+    """One inbound RTP stream: jitter buffer → depayloader → frames."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.jitter = JitterBuffer()
+        self.depayloader = H264Depayloader() if kind == "video" \
+            else OpusDepayloader()
+        self.on_frame: Optional[Callable[[bytes, int], None]] = None
+        self.last_ssrc = 0
+        self.packets = 0
+
+    def feed(self, packet: RtpPacket) -> None:
+        self.last_ssrc = packet.ssrc
+        self.packets += 1
+        if self.kind == "audio":
+            if self.on_frame is not None:
+                self.on_frame(self.depayloader.feed(packet), packet.timestamp)
+            return
+        for pkt in self.jitter.add(packet):
+            frame = self.depayloader.feed(pkt)
+            if frame is not None and self.on_frame is not None:
+                self.on_frame(frame, pkt.timestamp)
+
+
+class PeerConnection:
+    def __init__(
+        self,
+        certificate: Optional[DtlsCertificate] = None,
+        stun_server: Optional[Tuple[str, int]] = None,
+        interfaces: Optional[List[str]] = None,
+    ):
+        self.cert = certificate or DtlsCertificate.generate()
+        self._stun_server = stun_server
+        self._interfaces = interfaces
+        self.ice: Optional[IceAgent] = None
+        self.dtls: Optional[DtlsEndpoint] = None
+        self.sctp: Optional[SctpAssociation] = None
+        self.srtp_tx: Optional[SrtpContext] = None
+        self.srtp_rx: Optional[SrtpContext] = None
+        self.gcc = GccEstimator()
+
+        self.senders: Dict[int, MediaSender] = {}      # ssrc -> sender
+        self.receivers: Dict[int, MediaReceiver] = {}  # payload type -> recv
+        self.on_channel: Optional[Callable[[DataChannel], None]] = None
+        self.on_bitrate: Optional[Callable[[int], None]] = None
+        self.on_keyframe_request: Optional[Callable[[], None]] = None
+
+        self.is_offerer: Optional[bool] = None
+        self._local_desc: Optional[SessionDescription] = None
+        self._remote_desc: Optional[SessionDescription] = None
+        self._pending_channels: List[Tuple[str, dict]] = []
+        self._connected = asyncio.Event()
+        self._closed = False
+        self._run_task: Optional[asyncio.Task] = None
+        self._want_data_section = False
+
+    # ------------------------------------------------------------ tracks
+
+    def add_video_sender(self, ssrc: Optional[int] = None) -> MediaSender:
+        ssrc = ssrc or struct.unpack("!I", os.urandom(4))[0]
+        s = MediaSender(self, "video", ssrc, VIDEO_PT, VIDEO_CLOCK)
+        self.senders[ssrc] = s
+        return s
+
+    def add_audio_sender(self, ssrc: Optional[int] = None) -> MediaSender:
+        ssrc = ssrc or struct.unpack("!I", os.urandom(4))[0]
+        s = MediaSender(self, "audio", ssrc, AUDIO_PT, 48000)
+        self.senders[ssrc] = s
+        return s
+
+    def video_receiver(self) -> MediaReceiver:
+        return self.receivers.setdefault(VIDEO_PT, MediaReceiver("video"))
+
+    def audio_receiver(self) -> MediaReceiver:
+        return self.receivers.setdefault(AUDIO_PT, MediaReceiver("audio"))
+
+    def create_data_channel(self, label: str, protocol: str = "",
+                            ordered: bool = True,
+                            max_retransmits: Optional[int] = None
+                            ) -> "DataChannelHandle":
+        self._want_data_section = True
+        handle = DataChannelHandle(label, protocol, ordered, max_retransmits)
+        self._pending_channels.append(handle)
+        if self.sctp is not None and self.sctp.state == "established":
+            handle.bind(self.sctp)
+        return handle
+
+    # -------------------------------------------------------------- JSEP
+
+    async def create_offer(self) -> str:
+        self.is_offerer = True
+        await self._ensure_ice(controlling=True)
+        self._local_desc = self._describe(setup="actpass")
+        return self._local_desc.serialize()
+
+    async def create_answer(self) -> str:
+        if self._remote_desc is None:
+            raise RuntimeError("set_remote_description first")
+        self.is_offerer = False
+        await self._ensure_ice(controlling=False)
+        self._local_desc = self._describe(setup="active")
+        self._start_transport()
+        return self._local_desc.serialize()
+
+    async def set_remote_description(self, sdp: str, sdp_type: str) -> None:
+        self._remote_desc = SessionDescription.parse(sdp)
+        media = self._remote_desc.media
+        if not media:
+            raise ValueError("no media sections")
+        m0 = media[0]
+        if self.ice is not None:
+            if m0.ice_ufrag and m0.ice_pwd:
+                self.ice.set_remote_credentials(m0.ice_ufrag, m0.ice_pwd)
+            for m in media:
+                for cand in m.candidates:
+                    self.ice.add_remote_candidate(cand)
+        if sdp_type == "answer" and self.is_offerer:
+            self._start_transport()
+
+    def add_ice_candidate(self, candidate_sdp: str) -> None:
+        if self.ice is not None:
+            self.ice.add_remote_candidate(Candidate.from_sdp(candidate_sdp))
+
+    async def wait_connected(self, timeout: float = 15.0) -> None:
+        await asyncio.wait_for(self._connected.wait(), timeout)
+
+    # ---------------------------------------------------------- internals
+
+    async def _ensure_ice(self, controlling: bool) -> None:
+        if self.ice is not None:
+            return
+        self.ice = IceAgent(controlling=controlling,
+                            stun_server=self._stun_server,
+                            interfaces=self._interfaces)
+        await self.ice.gather()
+        self.ice.on_data = self._ice_data
+        if self._remote_desc is not None:
+            m0 = self._remote_desc.media[0]
+            if m0.ice_ufrag and m0.ice_pwd:
+                self.ice.set_remote_credentials(m0.ice_ufrag, m0.ice_pwd)
+            for m in self._remote_desc.media:
+                for cand in m.candidates:
+                    self.ice.add_remote_candidate(cand)
+
+    def _describe(self, setup: str) -> SessionDescription:
+        mids = []
+        media = []
+        fingerprint = self.cert.fingerprint()
+        common = dict(
+            ice_ufrag=self.ice.local_ufrag, ice_pwd=self.ice.local_pwd,
+            dtls_fingerprint=fingerprint, dtls_setup=setup,
+            candidates=list(self.ice.local_candidates),
+            end_of_candidates=True)
+        video_ssrc = next((s.ssrc for s in self.senders.values()
+                           if s.kind == "video"), None)
+        audio_ssrc = next((s.ssrc for s in self.senders.values()
+                           if s.kind == "audio"), None)
+        mid = 0
+        media.append(MediaSection(
+            kind="video", mid=str(mid), codecs=default_video_codecs(),
+            ssrc=video_ssrc, cname="selkies-tpu",
+            msid="selkies video0", direction="sendrecv", **common))
+        mids.append(str(mid)); mid += 1
+        media.append(MediaSection(
+            kind="audio", mid=str(mid), codecs=default_audio_codecs(),
+            ssrc=audio_ssrc, cname="selkies-tpu",
+            msid="selkies audio0", direction="sendrecv", **common))
+        mids.append(str(mid)); mid += 1
+        if self._want_data_section or (
+                self._remote_desc is not None and any(
+                    m.kind == "application" for m in self._remote_desc.media)):
+            media.append(MediaSection(
+                kind="application", mid=str(mid),
+                protocol="UDP/DTLS/SCTP", sctp_port=5000,
+                max_message_size=262144, **common))
+            mids.append(str(mid))
+        return SessionDescription(
+            session_id=struct.unpack("!I", os.urandom(4))[0],
+            media=media, bundle=mids)
+
+    def _start_transport(self) -> None:
+        remote_m0 = self._remote_desc.media[0]
+        remote_fp = remote_m0.dtls_fingerprint
+        # offerer offered actpass; answerer is active (DTLS client)
+        is_dtls_client = not self.is_offerer
+        self.dtls = DtlsEndpoint(
+            is_client=is_dtls_client, certificate=self.cert,
+            on_send=self._dtls_send, remote_fingerprint=remote_fp)
+        self.dtls.on_data = self._dtls_app_data
+        want_sctp = any(m.kind == "application"
+                        for m in self._remote_desc.media) \
+            or self._want_data_section
+        if want_sctp:
+            self.sctp = SctpAssociation(
+                is_client=is_dtls_client,
+                on_send=lambda d: self.dtls.send_app_data(d))
+            self.sctp.on_channel = self._sctp_channel
+        self._run_task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        try:
+            await self.ice.connect()
+        except Exception as exc:
+            logger.error("ICE failed: %s", exc)
+            return
+        self.dtls.start()
+        # drive DTLS to completion
+        for _ in range(600):
+            if self.dtls.handshake_complete or self.dtls.handshake_failed:
+                break
+            self.dtls.check_retransmit()
+            await asyncio.sleep(0.02)
+        if not self.dtls.handshake_complete:
+            logger.error("DTLS failed: %s", self.dtls.handshake_failed)
+            return
+        keying = self.dtls.export_srtp()
+        self.srtp_tx, self.srtp_rx = srtp_pair_from_dtls(
+            keying, is_client=self.dtls.is_client)
+        if self.sctp is not None:
+            self.sctp.start()
+        self._connected.set()
+        last_sr = 0.0
+        while not self._closed:
+            now = time.monotonic()
+            if self.sctp is not None:
+                self.sctp.check_retransmit(now)
+                for handle in self._pending_channels:
+                    if not handle.bound and self.sctp.state == "established":
+                        handle.bind(self.sctp)
+            if now - last_sr > 2.0 and self.srtp_tx is not None:
+                last_sr = now
+                self._send_sender_reports(now)
+            await asyncio.sleep(0.05)
+
+    # ------------------------------------------------------------- demux
+
+    def _ice_data(self, data: bytes) -> None:
+        if not data:
+            return
+        b0 = data[0]
+        if 20 <= b0 <= 63:
+            self.dtls and self.dtls.receive(data)
+        elif 128 <= b0 <= 191 and self.srtp_rx is not None:
+            if is_rtcp(data):
+                self._handle_rtcp(data)
+            else:
+                self._handle_rtp(data)
+
+    def _handle_rtp(self, data: bytes) -> None:
+        try:
+            plain = self.srtp_rx.unprotect_rtp(data)
+        except ValueError:
+            return
+        try:
+            pkt = RtpPacket.parse(plain)
+        except ValueError:
+            return
+        recv = self.receivers.get(pkt.payload_type)
+        if recv is not None:
+            recv.feed(pkt)
+
+    def _handle_rtcp(self, data: bytes) -> None:
+        try:
+            plain = self.srtp_rx.unprotect_rtcp(data)
+        except ValueError:
+            return
+        for pkt in parse_rtcp(plain):
+            if isinstance(pkt, RtcpPli) and self.on_keyframe_request:
+                self.on_keyframe_request()
+            elif isinstance(pkt, RtcpReceiverReport):
+                for r in pkt.reports:
+                    self.gcc.add_loss_report(r.fraction_lost / 256.0)
+                if self.on_bitrate:
+                    self.on_bitrate(self.gcc.bitrate)
+            elif isinstance(pkt, RtcpNack):
+                pass  # retransmission buffer: future work
+
+    def _dtls_send(self, data: bytes) -> None:
+        try:
+            self.ice.send(data)
+        except ConnectionError:
+            pass
+
+    def _dtls_app_data(self, data: bytes) -> None:
+        if self.sctp is not None:
+            self.sctp.receive(data)
+
+    def _send_rtp(self, raw: bytes) -> None:
+        if self.srtp_tx is None:
+            return
+        try:
+            self.ice.send(self.srtp_tx.protect_rtp(raw))
+        except ConnectionError:
+            pass
+
+    def _send_sender_reports(self, now: float) -> None:
+        ntp = int((now + 2208988800) * (1 << 32)) & 0xFFFFFFFFFFFFFFFF
+        for s in self.senders.values():
+            sr = s.sender_report(ntp, int(now * s.clock_rate) & 0xFFFFFFFF)
+            try:
+                self.ice.send(self.srtp_tx.protect_rtcp(sr.serialize()))
+            except (ConnectionError, ValueError):
+                pass
+
+    def request_keyframe(self, media_ssrc: int) -> None:
+        if self.srtp_tx is None:
+            return
+        pli = RtcpPli(sender_ssrc=1, media_ssrc=media_ssrc)
+        try:
+            self.ice.send(self.srtp_tx.protect_rtcp(pli.serialize()))
+        except ConnectionError:
+            pass
+
+    def _sctp_channel(self, ch: DataChannel) -> None:
+        if self.on_channel is not None:
+            self.on_channel(ch)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._run_task is not None:
+            self._run_task.cancel()
+        if self.ice is not None:
+            await self.ice.close()
+
+
+class DataChannelHandle:
+    """Pre-negotiation handle; binds to the SCTP association once up."""
+
+    def __init__(self, label: str, protocol: str, ordered: bool,
+                 max_retransmits: Optional[int]):
+        self.label = label
+        self.protocol = protocol
+        self.ordered = ordered
+        self.max_retransmits = max_retransmits
+        self.channel: Optional[DataChannel] = None
+        self.on_message: Optional[Callable[[bytes], None]] = None
+        self.on_open: Optional[Callable[[], None]] = None
+        self._sctp: Optional[SctpAssociation] = None
+
+    @property
+    def bound(self) -> bool:
+        return self.channel is not None
+
+    @property
+    def open(self) -> bool:
+        return self.channel is not None and self.channel.open
+
+    def bind(self, sctp: SctpAssociation) -> None:
+        self._sctp = sctp
+        self.channel = sctp.create_channel(
+            self.label, self.protocol, self.ordered, self.max_retransmits)
+        self.channel.on_message = lambda d: self.on_message and self.on_message(d)
+        self.channel.on_open = lambda: self.on_open and self.on_open()
+
+    def send(self, data) -> None:
+        if not self.open:
+            raise ConnectionError("channel not open")
+        self._sctp.send(self.channel, data)

@@ -228,18 +228,23 @@ class GccEstimator:
         return self.bitrate
 
     def feed_twcc(self, received: List[Tuple[int, Optional[int]]],
-                  send_times_ms: dict) -> int:
+                  send_info: dict) -> int:
         """Sender-side estimation from a TWCC feedback packet: ``received``
-        is RtcpTwcc.received; ``send_times_ms`` maps twcc-seq → local send
-        time (ms)."""
+        is RtcpTwcc.received; ``send_info`` maps twcc-seq → either a send
+        time (ms) or a ``(send_ms, size_bytes)`` tuple — real sizes keep
+        the AIMD decrease target honest."""
         lost = sum(1 for _, t in received if t is None)
         if received:
             self.loss.update(lost / len(received))
         for seq, t_us in received:
             if t_us is None:
                 continue
-            send_ms = send_times_ms.get(seq)
-            if send_ms is None:
+            info = send_info.get(seq)
+            if info is None:
                 continue
-            self.delay.add_packet(send_ms, t_us / 1000.0, 1200)
+            if isinstance(info, tuple):
+                send_ms, size = info
+            else:
+                send_ms, size = info, 1200
+            self.delay.add_packet(send_ms, t_us / 1000.0, size)
         return self.bitrate

@@ -229,9 +229,9 @@ class RtcpSdes:
                 if t == 1:
                     cname = val.decode(errors="replace")
                 pos += 2 + ln
-            while pos < len(body) and body[pos] == 0:
-                pos += 1
-            pos = (pos + 3) & ~3 if pos % 4 else pos
+            # one terminator octet, then pad the CHUNK to a 32-bit boundary
+            pos += 1
+            pos = (pos + 3) & ~3
             items.append((ssrc, cname))
         return cls(items)
 
@@ -366,13 +366,15 @@ class RtcpTwcc:
                 symbols.append(TWCC_SYMBOL_NOT_RECEIVED)
                 continue
             delta = (t - prev_time) // 250
-            prev_time = prev_time + delta * 250
             if 0 <= delta <= 255:
                 symbols.append(TWCC_SYMBOL_SMALL_DELTA)
                 deltas += bytes([delta])
             else:
+                delta = max(-32768, min(32767, delta))
                 symbols.append(TWCC_SYMBOL_LARGE_DELTA)
-                deltas += struct.pack("!h", max(-32768, min(32767, delta)))
+                deltas += struct.pack("!h", delta)
+            # advance by the value actually encoded, as the parser will
+            prev_time = prev_time + delta * 250
         # encode all symbols as two-bit status vector chunks (7 per chunk)
         chunks = b""
         for i in range(0, len(symbols), 7):

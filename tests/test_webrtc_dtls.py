@@ -1,0 +1,194 @@
+"""DTLS 1.2 handshake + SRTP keying tests (loopback, lossy transport).
+
+Parity target: vendored ``webrtc/rtcdtlstransport.py`` behavior — mutual
+certificate handshake, fingerprint verification, SRTP key export, app
+data — without OpenSSL/pylibsrtp (SURVEY.md §2.4)."""
+
+import random
+
+import pytest
+
+from selkies_tpu.webrtc.dtls import DtlsCertificate, DtlsEndpoint
+from selkies_tpu.webrtc.srtp import (SrtpContext, kdf, srtp_pair_from_dtls,
+                                     SRTP_AES128_CM_HMAC_SHA1_80)
+from selkies_tpu.webrtc.rtp import RtpPacket, RtcpReceiverReport
+
+
+def pump(client, server, client_out, server_out, drop=None, max_iters=200):
+    """Deliver queued datagrams until both sides are done or stuck."""
+    rng = random.Random(7)
+    for _ in range(max_iters):
+        moved = False
+        while client_out:
+            d = client_out.pop(0)
+            moved = True
+            if drop is None or rng.random() > drop:
+                server.receive(d)
+        while server_out:
+            d = server_out.pop(0)
+            moved = True
+            if drop is None or rng.random() > drop:
+                client.receive(d)
+        if client.handshake_complete and server.handshake_complete:
+            return True
+        if client.handshake_failed or server.handshake_failed:
+            return False
+        if not moved:
+            # simulate timers
+            client.check_retransmit(now=1e9)
+            server.check_retransmit(now=1e9)
+            if not client_out and not server_out:
+                return client.handshake_complete and server.handshake_complete
+    return client.handshake_complete and server.handshake_complete
+
+
+def make_pair(check_fp=True):
+    ccert = DtlsCertificate.generate()
+    scert = DtlsCertificate.generate()
+    client_out, server_out = [], []
+    client = DtlsEndpoint(
+        is_client=True, certificate=ccert,
+        on_send=client_out.append,
+        remote_fingerprint=scert.fingerprint() if check_fp else None)
+    server = DtlsEndpoint(
+        is_client=False, certificate=scert,
+        on_send=server_out.append,
+        remote_fingerprint=ccert.fingerprint() if check_fp else None)
+    return client, server, client_out, server_out
+
+
+def test_handshake_loopback():
+    client, server, co, so = make_pair()
+    server.start()
+    client.start()
+    assert pump(client, server, co, so)
+    assert client.handshake_complete and server.handshake_complete
+    # both export identical SRTP keying material
+    assert client.export_srtp() == server.export_srtp()
+    assert len(client.export_srtp()) == 60
+
+
+def test_handshake_rejects_wrong_fingerprint():
+    ccert = DtlsCertificate.generate()
+    scert = DtlsCertificate.generate()
+    rogue = DtlsCertificate.generate()
+    co, so = [], []
+    client = DtlsEndpoint(True, ccert, co.append,
+                          remote_fingerprint=rogue.fingerprint())
+    server = DtlsEndpoint(False, scert, so.append,
+                          remote_fingerprint=ccert.fingerprint())
+    server.start()
+    client.start()
+    assert not pump(client, server, co, so)
+    assert client.handshake_failed
+
+
+def test_app_data_after_handshake():
+    client, server, co, so = make_pair()
+    server.start()
+    client.start()
+    assert pump(client, server, co, so)
+    got = []
+    server.on_data = got.append
+    client.send_app_data(b"sctp-chunk-here")
+    while co:
+        server.receive(co.pop(0))
+    assert got == [b"sctp-chunk-here"]
+    got_c = []
+    client.on_data = got_c.append
+    server.send_app_data(b"reply")
+    while so:
+        client.receive(so.pop(0))
+    assert got_c == [b"reply"]
+
+
+def test_handshake_with_packet_loss():
+    client, server, co, so = make_pair()
+    server.start()
+    client.start()
+    assert pump(client, server, co, so, drop=0.25, max_iters=1000)
+    assert client.export_srtp() == server.export_srtp()
+
+
+def test_fingerprint_format():
+    cert = DtlsCertificate.generate()
+    fp = cert.fingerprint()
+    assert fp.startswith("sha-256 ")
+    parts = fp.split(" ")[1].split(":")
+    assert len(parts) == 32 and all(len(p) == 2 for p in parts)
+
+
+# ------------------------------------------------------------------ SRTP
+
+
+def test_srtp_kdf_rfc3711_vectors():
+    mk = bytes.fromhex("E1F97A0D3E018BE0D64FA32C06DE4139")
+    ms = bytes.fromhex("0EC675AD498AFEEBB6960B3AABE6")
+    assert kdf(mk, ms, 0x00, 16).hex().upper() == \
+        "C61E7A93744F39EE10734AFE3FF7A087"
+    assert kdf(mk, ms, 0x02, 14).hex().upper() == \
+        "30CBBC08863D8C85D49DB34A9AE1"
+    assert kdf(mk, ms, 0x01, 20).hex().upper() == \
+        "CEBE321F6FF7716B6FD4AB49AF256A156D38BAA4"
+
+
+def test_srtp_rtp_roundtrip_and_replay():
+    key, salt = b"k" * 16, b"s" * 14
+    tx = SrtpContext(key, salt)
+    rx = SrtpContext(key, salt)
+    pkt = RtpPacket(payload_type=102, sequence_number=1000, timestamp=90000,
+                    ssrc=0x1234, payload=b"video-bytes" * 20).serialize()
+    protected = tx.protect_rtp(pkt)
+    assert protected != pkt and len(protected) == len(pkt) + 10
+    assert rx.unprotect_rtp(protected) == pkt
+    with pytest.raises(ValueError, match="replay"):
+        rx.unprotect_rtp(protected)
+
+
+def test_srtp_auth_failure():
+    tx = SrtpContext(b"k" * 16, b"s" * 14)
+    rx = SrtpContext(b"k" * 16, b"s" * 14)
+    pkt = RtpPacket(payload_type=96, sequence_number=5, ssrc=9,
+                    payload=b"x" * 50).serialize()
+    protected = bytearray(tx.protect_rtp(pkt))
+    protected[20] ^= 0xFF
+    with pytest.raises(ValueError, match="auth"):
+        rx.unprotect_rtp(bytes(protected))
+
+
+def test_srtp_seq_rollover():
+    key, salt = b"a" * 16, b"b" * 14
+    tx = SrtpContext(key, salt)
+    rx = SrtpContext(key, salt)
+    for seq in (65534, 65535, 0, 1):   # crosses ROC boundary
+        pkt = RtpPacket(payload_type=96, sequence_number=seq, ssrc=7,
+                        payload=bytes([seq & 0xFF]) * 10).serialize()
+        assert rx.unprotect_rtp(tx.protect_rtp(pkt)) == pkt
+    assert tx._roc[7] == 1
+
+
+def test_srtcp_roundtrip():
+    key, salt = b"q" * 16, b"w" * 14
+    tx = SrtpContext(key, salt)
+    rx = SrtpContext(key, salt)
+    rtcp = RtcpReceiverReport(ssrc=77).serialize()
+    protected = tx.protect_rtcp(rtcp)
+    assert rx.unprotect_rtcp(protected) == rtcp
+    with pytest.raises(ValueError, match="replay"):
+        rx.unprotect_rtcp(protected)
+
+
+def test_dtls_srtp_end_to_end():
+    """Full stack: DTLS handshake → exporter → SRTP contexts → media."""
+    client, server, co, so = make_pair()
+    server.start()
+    client.start()
+    assert pump(client, server, co, so)
+    c_tx, c_rx = srtp_pair_from_dtls(client.export_srtp(), is_client=True)
+    s_tx, s_rx = srtp_pair_from_dtls(server.export_srtp(), is_client=False)
+    media = RtpPacket(payload_type=102, sequence_number=42, ssrc=1,
+                      payload=b"h264" * 100).serialize()
+    assert s_rx.unprotect_rtp(c_tx.protect_rtp(media)) == media
+    back = RtpPacket(payload_type=111, sequence_number=1, ssrc=2,
+                     payload=b"opus" * 40).serialize()
+    assert c_rx.unprotect_rtp(s_tx.protect_rtp(back)) == back

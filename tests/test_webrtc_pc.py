@@ -1,0 +1,113 @@
+"""Full-stack PeerConnection loopback: SDP offer/answer → ICE → DTLS-SRTP →
+H.264/Opus media + data channels over real UDP sockets.
+
+This is the transport-phase E2E the reference stages its vendored aiortc
+for (SURVEY.md §2.4): externally-encoded H.264 carried without re-encode.
+"""
+
+import asyncio
+
+import pytest
+
+from selkies_tpu.webrtc.peerconnection import PeerConnection
+
+
+def make_au(tag: bytes) -> bytes:
+    sps = bytes([0x67, 1, 2, 3])
+    idr = bytes([0x65]) + tag * 300
+    return b"\x00\x00\x00\x01" + sps + b"\x00\x00\x00\x01" + idr
+
+
+def test_peerconnection_end_to_end():
+    async def run():
+        offerer = PeerConnection(interfaces=["127.0.0.1"])
+        answerer = PeerConnection(interfaces=["127.0.0.1"])
+
+        video_out = offerer.add_video_sender(ssrc=0x1111)
+        audio_out = offerer.add_audio_sender(ssrc=0x2222)
+        input_ch = offerer.create_data_channel("input")
+
+        got_video = []
+        got_audio = []
+        got_input = []
+        answerer.video_receiver().on_frame = \
+            lambda f, ts: got_video.append((f, ts))
+        answerer.audio_receiver().on_frame = \
+            lambda f, ts: got_audio.append((f, ts))
+
+        def on_channel(ch):
+            ch.on_message = got_input.append
+        answerer.on_channel = on_channel
+
+        offer = await offerer.create_offer()
+        await answerer.set_remote_description(offer, "offer")
+        answer = await answerer.create_answer()
+        await offerer.set_remote_description(answer, "answer")
+
+        await asyncio.gather(offerer.wait_connected(15),
+                             answerer.wait_connected(15))
+
+        # media: 5 video AUs + 5 opus frames
+        for i in range(5):
+            video_out.send_frame(make_au(bytes([i + 1])), timestamp=i * 3000)
+            audio_out.send_frame(b"opus-%d" % i, timestamp=i * 960)
+            await asyncio.sleep(0.02)
+        for _ in range(100):
+            if len(got_video) >= 5 and len(got_audio) >= 5:
+                break
+            await asyncio.sleep(0.05)
+        assert len(got_video) == 5
+        assert len(got_audio) == 5
+        frame0, ts0 = got_video[0]
+        assert ts0 == 0 and frame0.startswith(b"\x00\x00\x00\x01\x67")
+        assert bytes([0x65]) + b"\x01" * 3 in frame0
+        assert got_audio[0][0] == b"opus-0"
+
+        # data channel: wait for DCEP then exchange input messages
+        for _ in range(200):
+            if input_ch.open:
+                break
+            await asyncio.sleep(0.05)
+        assert input_ch.open
+        input_ch.send("kd,65")
+        input_ch.send(b"\x02binary")
+        for _ in range(100):
+            if len(got_input) >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert got_input == [b"kd,65", b"\x02binary"]
+
+        await offerer.close()
+        await answerer.close()
+
+    asyncio.run(run())
+
+
+def test_peerconnection_bidirectional_media():
+    async def run():
+        a = PeerConnection(interfaces=["127.0.0.1"])
+        b = PeerConnection(interfaces=["127.0.0.1"])
+        a_video = a.add_video_sender(ssrc=0xA)
+        b_video = b.add_video_sender(ssrc=0xB)
+        got_a, got_b = [], []
+        a.video_receiver().on_frame = lambda f, ts: got_a.append(f)
+        b.video_receiver().on_frame = lambda f, ts: got_b.append(f)
+
+        offer = await a.create_offer()
+        await b.set_remote_description(offer, "offer")
+        answer = await b.create_answer()
+        await a.set_remote_description(answer, "answer")
+        await asyncio.gather(a.wait_connected(15), b.wait_connected(15))
+
+        a_video.send_frame(make_au(b"\xaa"), timestamp=1)
+        b_video.send_frame(make_au(b"\xbb"), timestamp=2)
+        for _ in range(100):
+            if got_a and got_b:
+                break
+            await asyncio.sleep(0.05)
+        assert got_a and b"\xbb" in got_a[0]
+        assert got_b and b"\xaa" in got_b[0]
+        await a.close()
+        await b.close()
+
+    asyncio.run(run())

@@ -266,3 +266,30 @@ def test_gcc_combined_takes_min():
     g = GccEstimator(2_000_000)
     g.add_loss_report(0.5)
     assert g.bitrate == g.loss.bitrate < 2_000_000
+
+
+def test_rtcp_sdes_multiple_chunks():
+    # regression: chunk padding must not eat the next chunk's SSRC
+    from selkies_tpu.webrtc.rtp import RtcpSdes
+    s = RtcpSdes(items=[(9, "a"), (7, "b"), (0x01020304, "ccc")])
+    got = parse_rtcp(s.serialize())[0]
+    assert got.items == [(9, "a"), (7, "b"), (0x01020304, "ccc")]
+
+
+def test_h264_fua_gap_resets_reassembly():
+    au, nals = make_au()
+    pay = H264Payloader(mtu=500)
+    pkts = pay.packetize(au, ssrc=1, payload_type=102,
+                         sequence_number=0, timestamp=0)
+    fua = [i for i, p in enumerate(pkts) if p.payload[0] & 0x1F == 28]
+    del pkts[fua[1]]
+    depay = H264Depayloader()
+    out = None
+    for p in pkts:
+        got = depay.feed(p)
+        if got is not None:
+            out = got
+    recovered = split_annexb(out)
+    # the fragmented IDR must be absent entirely, not spliced corrupt
+    assert nals[0] in recovered and nals[1] in recovered
+    assert all(len(n) < 1000 for n in recovered)
