@@ -1,0 +1,447 @@
+/*
+ * fake_udev.c — drop-in libudev.so.1 replacement fabricating the four
+ * selkies virtual gamepads for device discovery inside containers.
+ *
+ * SDL2/Wine/game engines enumerate joysticks through libudev; in a
+ * container there is no udevd and no /run/udev database, so enumeration
+ * finds nothing even though the joystick interposer (joystick_interposer.c)
+ * can serve /dev/input/js0-3 + event1000-1003. Preloading (or bind-mounting
+ * over libudev.so.1) this stub makes enumeration return exactly those
+ * devices with the properties SDL checks (ID_INPUT_JOYSTICK=1 etc.), and
+ * provides an inert monitor whose fd never signals.
+ *
+ * Role parity with the reference's addons/fake-udev (SURVEY.md §2.2);
+ * fresh implementation. Build: make -C native/fake-udev
+ */
+
+#define _GNU_SOURCE
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#define NUM_PADS 4
+#define EVDEV_BASE 1000
+
+/* opaque handle types (all alias to internal structs) */
+struct udev { int refs; };
+
+typedef struct fake_device {
+    const char *syspath;
+    const char *sysname;
+    const char *devnode;
+    const char *subsystem;
+    int is_evdev;
+    int pad;
+    int refs;
+} fake_device;
+
+struct udev_device { fake_device d; };
+
+struct udev_list_entry {
+    const char *name;
+    const char *value;
+    struct udev_list_entry *next;
+};
+
+struct udev_enumerate {
+    struct udev *udev;
+    int want_input;
+    int refs;
+    struct udev_list_entry entries[NUM_PADS * 2 + 1];
+    char names[NUM_PADS * 2][64];
+    int count;
+};
+
+struct udev_monitor {
+    int pipefd[2];
+    int refs;
+};
+
+/* ------------------------------------------------------------- tables */
+
+static char g_syspaths[NUM_PADS * 2][64];
+static char g_sysnames[NUM_PADS * 2][32];
+static char g_devnodes[NUM_PADS * 2][32];
+static int g_init_done = 0;
+
+static void tables_init(void)
+{
+    if (g_init_done) return;
+    for (int i = 0; i < NUM_PADS; i++) {
+        /* js device at slot i, evdev device at slot NUM_PADS + i */
+        snprintf(g_sysnames[i], sizeof(g_sysnames[i]), "js%d", i);
+        snprintf(g_syspaths[i], sizeof(g_syspaths[i]),
+                 "/sys/devices/virtual/input/input%d/js%d", i, i);
+        snprintf(g_devnodes[i], sizeof(g_devnodes[i]), "/dev/input/js%d", i);
+        int e = EVDEV_BASE + i;
+        snprintf(g_sysnames[NUM_PADS + i], sizeof(g_sysnames[0]),
+                 "event%d", e);
+        snprintf(g_syspaths[NUM_PADS + i], sizeof(g_syspaths[0]),
+                 "/sys/devices/virtual/input/input%d/event%d", i, e);
+        snprintf(g_devnodes[NUM_PADS + i], sizeof(g_devnodes[0]),
+                 "/dev/input/event%d", e);
+    }
+    g_init_done = 1;
+}
+
+static int slot_from_syspath(const char *syspath)
+{
+    tables_init();
+    if (!syspath) return -1;
+    for (int i = 0; i < NUM_PADS * 2; i++)
+        if (strcmp(g_syspaths[i], syspath) == 0) return i;
+    return -1;
+}
+
+/* ---------------------------------------------------------- udev core */
+
+struct udev *udev_new(void)
+{
+    tables_init();
+    struct udev *u = calloc(1, sizeof(*u));
+    if (u) u->refs = 1;
+    return u;
+}
+
+struct udev *udev_ref(struct udev *u)
+{
+    if (u) u->refs++;
+    return u;
+}
+
+struct udev *udev_unref(struct udev *u)
+{
+    if (u && --u->refs == 0) free(u);
+    return NULL;
+}
+
+void udev_set_log_fn(struct udev *u, void *fn) { (void)u; (void)fn; }
+void udev_set_log_priority(struct udev *u, int p) { (void)u; (void)p; }
+int udev_get_log_priority(struct udev *u) { (void)u; return 0; }
+void *udev_get_userdata(struct udev *u) { (void)u; return NULL; }
+void udev_set_userdata(struct udev *u, void *d) { (void)u; (void)d; }
+
+/* ---------------------------------------------------------- enumerate */
+
+struct udev_enumerate *udev_enumerate_new(struct udev *u)
+{
+    struct udev_enumerate *e = calloc(1, sizeof(*e));
+    if (e) { e->udev = u; e->refs = 1; }
+    return e;
+}
+
+struct udev_enumerate *udev_enumerate_ref(struct udev_enumerate *e)
+{
+    if (e) e->refs++;
+    return e;
+}
+
+struct udev_enumerate *udev_enumerate_unref(struct udev_enumerate *e)
+{
+    if (e && --e->refs == 0) free(e);
+    return NULL;
+}
+
+int udev_enumerate_add_match_subsystem(struct udev_enumerate *e,
+                                       const char *subsystem)
+{
+    if (e && subsystem && strcmp(subsystem, "input") == 0)
+        e->want_input = 1;
+    return 0;
+}
+
+int udev_enumerate_add_match_property(struct udev_enumerate *e,
+                                      const char *prop, const char *value)
+{
+    (void)e; (void)prop; (void)value;
+    return 0;  /* our devices match the joystick properties SDL filters on */
+}
+
+int udev_enumerate_add_match_sysname(struct udev_enumerate *e,
+                                     const char *sysname)
+{
+    (void)e; (void)sysname;
+    return 0;
+}
+
+int udev_enumerate_add_match_tag(struct udev_enumerate *e, const char *tag)
+{
+    (void)e; (void)tag;
+    return 0;
+}
+
+int udev_enumerate_scan_devices(struct udev_enumerate *e)
+{
+    if (!e) return -1;
+    tables_init();
+    e->count = 0;
+    if (!e->want_input) return 0;
+    for (int i = 0; i < NUM_PADS * 2; i++) {
+        struct udev_list_entry *ent = &e->entries[e->count];
+        ent->name = g_syspaths[i];
+        ent->value = NULL;
+        ent->next = NULL;
+        if (e->count > 0)
+            e->entries[e->count - 1].next = ent;
+        e->count++;
+    }
+    return 0;
+}
+
+struct udev_list_entry *
+udev_enumerate_get_list_entry(struct udev_enumerate *e)
+{
+    if (!e || e->count == 0) return NULL;
+    return &e->entries[0];
+}
+
+struct udev *udev_enumerate_get_udev(struct udev_enumerate *e)
+{
+    return e ? e->udev : NULL;
+}
+
+/* --------------------------------------------------------- list entry */
+
+struct udev_list_entry *
+udev_list_entry_get_next(struct udev_list_entry *ent)
+{
+    return ent ? ent->next : NULL;
+}
+
+const char *udev_list_entry_get_name(struct udev_list_entry *ent)
+{
+    return ent ? ent->name : NULL;
+}
+
+const char *udev_list_entry_get_value(struct udev_list_entry *ent)
+{
+    return ent ? ent->value : NULL;
+}
+
+struct udev_list_entry *
+udev_list_entry_get_by_name(struct udev_list_entry *ent, const char *name)
+{
+    for (; ent; ent = ent->next)
+        if (ent->name && name && strcmp(ent->name, name) == 0) return ent;
+    return NULL;
+}
+
+/* ------------------------------------------------------------- device */
+
+static struct udev_device *device_for_slot(int slot)
+{
+    struct udev_device *d = calloc(1, sizeof(*d));
+    if (!d) return NULL;
+    d->d.syspath = g_syspaths[slot];
+    d->d.sysname = g_sysnames[slot];
+    d->d.devnode = g_devnodes[slot];
+    d->d.subsystem = "input";
+    d->d.is_evdev = slot >= NUM_PADS;
+    d->d.pad = slot % NUM_PADS;
+    d->d.refs = 1;
+    return d;
+}
+
+struct udev_device *udev_device_new_from_syspath(struct udev *u,
+                                                 const char *syspath)
+{
+    (void)u;
+    int slot = slot_from_syspath(syspath);
+    if (slot < 0) return NULL;
+    return device_for_slot(slot);
+}
+
+struct udev_device *udev_device_new_from_devnum(struct udev *u, char type,
+                                                unsigned long devnum)
+{
+    (void)u; (void)type;
+    tables_init();
+    /* major 13: js minors 0..3, event minors 64+EVDEV_BASE+i */
+    unsigned minor = devnum & 0xFF;
+    if (minor < NUM_PADS) return device_for_slot((int)minor);
+    return NULL;
+}
+
+struct udev_device *udev_device_ref(struct udev_device *d)
+{
+    if (d) d->d.refs++;
+    return d;
+}
+
+struct udev_device *udev_device_unref(struct udev_device *d)
+{
+    if (d && --d->d.refs == 0) free(d);
+    return NULL;
+}
+
+const char *udev_device_get_syspath(struct udev_device *d)
+{
+    return d ? d->d.syspath : NULL;
+}
+
+const char *udev_device_get_sysname(struct udev_device *d)
+{
+    return d ? d->d.sysname : NULL;
+}
+
+const char *udev_device_get_devnode(struct udev_device *d)
+{
+    return d ? d->d.devnode : NULL;
+}
+
+const char *udev_device_get_subsystem(struct udev_device *d)
+{
+    return d ? d->d.subsystem : NULL;
+}
+
+const char *udev_device_get_devtype(struct udev_device *d)
+{
+    (void)d;
+    return NULL;
+}
+
+const char *udev_device_get_action(struct udev_device *d)
+{
+    (void)d;
+    return "add";
+}
+
+unsigned long udev_device_get_devnum(struct udev_device *d)
+{
+    if (!d) return 0;
+    unsigned major = 13;
+    unsigned minor = d->d.is_evdev ? (64u + EVDEV_BASE + d->d.pad)
+                                   : (unsigned)d->d.pad;
+    return (major << 8) | (minor & 0xFF);
+}
+
+int udev_device_get_is_initialized(struct udev_device *d)
+{
+    (void)d;
+    return 1;
+}
+
+const char *udev_device_get_property_value(struct udev_device *d,
+                                           const char *key)
+{
+    static char buf[32];
+    if (!d || !key) return NULL;
+    if (strcmp(key, "ID_INPUT") == 0) return "1";
+    if (strcmp(key, "ID_INPUT_JOYSTICK") == 0) return "1";
+    if (strcmp(key, "DEVNAME") == 0) return d->d.devnode;
+    if (strcmp(key, "SUBSYSTEM") == 0) return d->d.subsystem;
+    if (strcmp(key, "ID_VENDOR_ID") == 0) return "045e";
+    if (strcmp(key, "ID_MODEL_ID") == 0) return "028e";
+    if (strcmp(key, "ID_BUS") == 0) return "usb";
+    if (strcmp(key, "MAJOR") == 0) return "13";
+    if (strcmp(key, "MINOR") == 0) {
+        snprintf(buf, sizeof(buf), "%lu",
+                 udev_device_get_devnum(d) & 0xFF);
+        return buf;
+    }
+    return NULL;
+}
+
+const char *udev_device_get_sysattr_value(struct udev_device *d,
+                                          const char *attr)
+{
+    if (!d || !attr) return NULL;
+    if (strcmp(attr, "name") == 0) return "Microsoft X-Box 360 pad";
+    if (strcmp(attr, "id/vendor") == 0) return "045e";
+    if (strcmp(attr, "id/product") == 0) return "028e";
+    if (strcmp(attr, "id/version") == 0) return "0114";
+    return NULL;
+}
+
+struct udev_device *udev_device_get_parent(struct udev_device *d)
+{
+    (void)d;
+    return NULL;  /* flat hierarchy; SDL tolerates missing parents */
+}
+
+struct udev_device *
+udev_device_get_parent_with_subsystem_devtype(struct udev_device *d,
+                                              const char *subsystem,
+                                              const char *devtype)
+{
+    (void)d; (void)subsystem; (void)devtype;
+    return NULL;
+}
+
+struct udev_list_entry *
+udev_device_get_properties_list_entry(struct udev_device *d)
+{
+    (void)d;
+    return NULL;
+}
+
+struct udev *udev_device_get_udev(struct udev_device *d)
+{
+    (void)d;
+    return NULL;
+}
+
+/* ------------------------------------------------------------ monitor */
+
+struct udev_monitor *udev_monitor_new_from_netlink(struct udev *u,
+                                                   const char *name)
+{
+    (void)u; (void)name;
+    struct udev_monitor *m = calloc(1, sizeof(*m));
+    if (!m) return NULL;
+    if (pipe2(m->pipefd, O_NONBLOCK | O_CLOEXEC) != 0) {
+        free(m);
+        return NULL;
+    }
+    m->refs = 1;
+    return m;
+}
+
+int udev_monitor_filter_add_match_subsystem_devtype(struct udev_monitor *m,
+                                                    const char *subsystem,
+                                                    const char *devtype)
+{
+    (void)m; (void)subsystem; (void)devtype;
+    return 0;
+}
+
+int udev_monitor_enable_receiving(struct udev_monitor *m)
+{
+    (void)m;
+    return 0;
+}
+
+int udev_monitor_get_fd(struct udev_monitor *m)
+{
+    return m ? m->pipefd[0] : -1;  /* never readable: hotplug never fires */
+}
+
+int udev_monitor_set_receive_buffer_size(struct udev_monitor *m, int size)
+{
+    (void)m; (void)size;
+    return 0;
+}
+
+struct udev_device *udev_monitor_receive_device(struct udev_monitor *m)
+{
+    (void)m;
+    return NULL;
+}
+
+struct udev_monitor *udev_monitor_ref(struct udev_monitor *m)
+{
+    if (m) m->refs++;
+    return m;
+}
+
+struct udev_monitor *udev_monitor_unref(struct udev_monitor *m)
+{
+    if (m && --m->refs == 0) {
+        close(m->pipefd[0]);
+        close(m->pipefd[1]);
+        free(m);
+    }
+    return NULL;
+}

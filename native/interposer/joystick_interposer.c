@@ -1,0 +1,410 @@
+/*
+ * joystick_interposer.c — LD_PRELOAD shim redirecting /dev/input joystick
+ * device access to the selkies-tpu virtual-gamepad unix sockets.
+ *
+ * Containerized games cannot see real /dev/input devices; the streaming
+ * server instead runs per-pad unix-socket servers
+ * (selkies_tpu/input/gamepad.py) speaking a tiny protocol:
+ *
+ *   connect  → server sends one 1360-byte js_config_t
+ *              { char name[255]; pad; u16 vendor,product,version,
+ *                num_btns,num_axes; u16 btn_map[512]; u8 axes_map[64]; pad[6] }
+ *   then     → a stream of struct js_event (js sockets) or
+ *              struct input_event (+ SYN_REPORT) (evdev sockets).
+ *
+ * This shim intercepts open()/openat()/access() on
+ *   /dev/input/js{0-3}          → /tmp/selkies_js{N}.sock
+ *   /dev/input/event{1000-1003} → /tmp/selkies_event{1000+N}.sock
+ * consumes the config blob at open time, returns the SOCKET fd to the
+ * application (reads/poll/epoll then work natively on the event stream),
+ * and answers the joystick/evdev ioctl surface from the stored config.
+ *
+ * Equivalent role to the reference's addons/js-interposer (protocol
+ * contract mirrored in selkies_tpu/input/gamepad.py); implementation is
+ * original. Build: make -C native/interposer
+ */
+
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/input.h>
+#include <linux/joystick.h>
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#define NUM_PADS 4
+#define NAME_LEN 255
+#define MAX_BTNS 512
+#define MAX_AXES 64
+#define EVDEV_BASE 1000
+
+typedef struct {
+    char name[NAME_LEN];
+    uint8_t _pad0;
+    uint16_t vendor;
+    uint16_t product;
+    uint16_t version;
+    uint16_t num_btns;
+    uint16_t num_axes;
+    uint16_t btn_map[MAX_BTNS];
+    uint8_t axes_map[MAX_AXES];
+    uint8_t _pad1[6];
+} __attribute__((packed)) js_config_t;
+
+_Static_assert(sizeof(js_config_t) == 1360, "js_config_t must be 1360 bytes");
+
+typedef struct {
+    int fd;          /* socket fd handed to the app; -1 = free slot */
+    int is_evdev;
+    js_config_t cfg;
+} shim_fd_t;
+
+#define MAX_SHIM_FDS 64
+static shim_fd_t g_fds[MAX_SHIM_FDS];
+static pthread_mutex_t g_lock = PTHREAD_MUTEX_INITIALIZER;
+
+static int (*real_open)(const char *, int, ...) = NULL;
+static int (*real_open64)(const char *, int, ...) = NULL;
+static int (*real_openat)(int, const char *, int, ...) = NULL;
+static int (*real_ioctl)(int, unsigned long, ...) = NULL;
+static int (*real_close)(int) = NULL;
+static int (*real_access)(const char *, int) = NULL;
+
+static void shim_init(void)
+{
+    static int done = 0;
+    if (done) return;
+    real_open   = dlsym(RTLD_NEXT, "open");
+    real_open64 = dlsym(RTLD_NEXT, "open64");
+    real_openat = dlsym(RTLD_NEXT, "openat");
+    real_ioctl  = dlsym(RTLD_NEXT, "ioctl");
+    real_close  = dlsym(RTLD_NEXT, "close");
+    real_access = dlsym(RTLD_NEXT, "access");
+    for (int i = 0; i < MAX_SHIM_FDS; i++) g_fds[i].fd = -1;
+    done = 1;
+}
+
+__attribute__((constructor)) static void shim_ctor(void) { shim_init(); }
+
+/* Map a device path to (pad index, is_evdev); -1 if not ours. */
+static int match_path(const char *path, int *is_evdev)
+{
+    if (!path) return -1;
+    int n;
+    if (sscanf(path, "/dev/input/js%d", &n) == 1 && n >= 0 && n < NUM_PADS) {
+        *is_evdev = 0;
+        return n;
+    }
+    if (sscanf(path, "/dev/input/event%d", &n) == 1 &&
+        n >= EVDEV_BASE && n < EVDEV_BASE + NUM_PADS) {
+        *is_evdev = 1;
+        return n - EVDEV_BASE;
+    }
+    return -1;
+}
+
+static void socket_path_for(int pad, int is_evdev, char *out, size_t cap)
+{
+    const char *dir = getenv("SELKIES_INTERPOSER_SOCKET_DIR");
+    if (!dir) dir = "/tmp";
+    if (is_evdev)
+        snprintf(out, cap, "%s/selkies_event%d.sock", dir, EVDEV_BASE + pad);
+    else
+        snprintf(out, cap, "%s/selkies_js%d.sock", dir, pad);
+}
+
+static ssize_t read_full(int fd, void *buf, size_t len)
+{
+    size_t got = 0;
+    while (got < len) {
+        ssize_t r = read(fd, (char *)buf + got, len - got);
+        if (r <= 0) {
+            if (r < 0 && (errno == EINTR)) continue;
+            return -1;
+        }
+        got += (size_t)r;
+    }
+    return (ssize_t)got;
+}
+
+static int shim_open_device(const char *path, int flags)
+{
+    int is_evdev = 0;
+    int pad = match_path(path, &is_evdev);
+    if (pad < 0) return -2; /* not ours */
+
+    char spath[256];
+    socket_path_for(pad, is_evdev, spath, sizeof(spath));
+
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    struct sockaddr_un addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, spath, sizeof(addr.sun_path) - 1);
+    if (connect(fd, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
+        real_close(fd);
+        errno = ENOENT;
+        return -1;
+    }
+
+    js_config_t cfg;
+    if (read_full(fd, &cfg, sizeof(cfg)) != (ssize_t)sizeof(cfg)) {
+        real_close(fd);
+        errno = EIO;
+        return -1;
+    }
+
+    /* protocol: reply with our pointer width so the server packs
+     * input_event timevals with the right layout */
+    uint8_t arch = (uint8_t)sizeof(void *);
+    if (write(fd, &arch, 1) != 1) {
+        real_close(fd);
+        errno = EIO;
+        return -1;
+    }
+
+    if (flags & O_NONBLOCK) {
+        int fl = fcntl(fd, F_GETFL, 0);
+        fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    }
+
+    pthread_mutex_lock(&g_lock);
+    for (int i = 0; i < MAX_SHIM_FDS; i++) {
+        if (g_fds[i].fd == -1) {
+            g_fds[i].fd = fd;
+            g_fds[i].is_evdev = is_evdev;
+            g_fds[i].cfg = cfg;
+            break;
+        }
+    }
+    pthread_mutex_unlock(&g_lock);
+    return fd;
+}
+
+static shim_fd_t *lookup(int fd)
+{
+    for (int i = 0; i < MAX_SHIM_FDS; i++)
+        if (g_fds[i].fd == fd) return &g_fds[i];
+    return NULL;
+}
+
+/* ------------------------------------------------------------- open() */
+
+int open(const char *path, int flags, ...)
+{
+    shim_init();
+    mode_t mode = 0;
+    if (flags & O_CREAT) {
+        va_list ap;
+        va_start(ap, flags);
+        mode = va_arg(ap, mode_t);
+        va_end(ap);
+    }
+    int r = shim_open_device(path, flags);
+    if (r != -2) return r;
+    return real_open(path, flags, mode);
+}
+
+int open64(const char *path, int flags, ...)
+{
+    shim_init();
+    mode_t mode = 0;
+    if (flags & O_CREAT) {
+        va_list ap;
+        va_start(ap, flags);
+        mode = va_arg(ap, mode_t);
+        va_end(ap);
+    }
+    int r = shim_open_device(path, flags);
+    if (r != -2) return r;
+    if (real_open64) return real_open64(path, flags, mode);
+    return real_open(path, flags, mode);
+}
+
+int openat(int dirfd, const char *path, int flags, ...)
+{
+    shim_init();
+    mode_t mode = 0;
+    if (flags & O_CREAT) {
+        va_list ap;
+        va_start(ap, flags);
+        mode = va_arg(ap, mode_t);
+        va_end(ap);
+    }
+    if (path && strncmp(path, "/dev/input/", 11) == 0) {
+        int r = shim_open_device(path, flags);
+        if (r != -2) return r;
+    }
+    return real_openat(dirfd, path, flags, mode);
+}
+
+int access(const char *path, int mode)
+{
+    shim_init();
+    int is_evdev;
+    if (match_path(path, &is_evdev) >= 0) return 0; /* device "exists" */
+    return real_access(path, mode);
+}
+
+int close(int fd)
+{
+    shim_init();
+    pthread_mutex_lock(&g_lock);
+    shim_fd_t *s = lookup(fd);
+    if (s) s->fd = -1;
+    pthread_mutex_unlock(&g_lock);
+    return real_close(fd);
+}
+
+/* ------------------------------------------------------------- ioctl() */
+
+static void set_bit(uint8_t *mask, int bit, size_t cap)
+{
+    if (bit >= 0 && (size_t)(bit / 8) < cap) mask[bit / 8] |= 1u << (bit % 8);
+}
+
+static int evdev_ioctl(shim_fd_t *s, unsigned long req, void *arg)
+{
+    js_config_t *c = &s->cfg;
+    unsigned dir = _IOC_DIR(req), type = _IOC_TYPE(req);
+    unsigned nr = _IOC_NR(req), size = _IOC_SIZE(req);
+    (void)dir;
+    if (type != 'E') { errno = EINVAL; return -1; }
+
+    if (nr == _IOC_NR(EVIOCGVERSION)) {
+        *(int *)arg = 0x010001;
+        return 0;
+    }
+    if (nr == _IOC_NR(EVIOCGID)) {
+        struct input_id *id = arg;
+        id->bustype = BUS_USB;
+        id->vendor = c->vendor;
+        id->product = c->product;
+        id->version = c->version;
+        return 0;
+    }
+    if (nr == _IOC_NR(EVIOCGNAME(0))) {
+        size_t n = strnlen(c->name, NAME_LEN);
+        if (n >= size) n = size ? size - 1 : 0;
+        memcpy(arg, c->name, n);
+        ((char *)arg)[n] = 0;
+        return (int)n;
+    }
+    if (nr >= _IOC_NR(EVIOCGBIT(0, 0)) &&
+        nr < _IOC_NR(EVIOCGBIT(EV_MAX, 0))) {
+        int ev = (int)(nr - _IOC_NR(EVIOCGBIT(0, 0)));
+        memset(arg, 0, size);
+        uint8_t *mask = arg;
+        if (ev == 0) {                      /* supported event types */
+            set_bit(mask, EV_SYN, size);
+            set_bit(mask, EV_KEY, size);
+            set_bit(mask, EV_ABS, size);
+        } else if (ev == EV_KEY) {
+            for (int i = 0; i < c->num_btns && i < MAX_BTNS; i++)
+                set_bit(mask, c->btn_map[i], size);
+        } else if (ev == EV_ABS) {
+            for (int i = 0; i < c->num_axes && i < MAX_AXES; i++)
+                set_bit(mask, c->axes_map[i], size);
+        }
+        return (int)size;
+    }
+    if (nr >= _IOC_NR(EVIOCGABS(0)) && nr <= _IOC_NR(EVIOCGABS(ABS_MAX))) {
+        int axis = (int)(nr - _IOC_NR(EVIOCGABS(0)));
+        struct input_absinfo *ai = arg;
+        memset(ai, 0, sizeof(*ai));
+        /* triggers 0..255, hats -1..1, sticks -32768..32767 */
+        if (axis == ABS_Z || axis == ABS_RZ) {
+            ai->minimum = 0; ai->maximum = 255;
+        } else if (axis >= ABS_HAT0X && axis <= ABS_HAT3Y) {
+            ai->minimum = -1; ai->maximum = 1;
+        } else {
+            ai->minimum = -32768; ai->maximum = 32767;
+            ai->fuzz = 16; ai->flat = 128;
+        }
+        return 0;
+    }
+    if (nr == _IOC_NR(EVIOCGPHYS(0)) || nr == _IOC_NR(EVIOCGUNIQ(0))) {
+        if (size) ((char *)arg)[0] = 0;
+        return 0;
+    }
+    if (nr == _IOC_NR(EVIOCGRAB)) return 0;
+    if (nr == _IOC_NR(EVIOCGKEY(0)) || nr == _IOC_NR(EVIOCGLED(0)) ||
+        nr == _IOC_NR(EVIOCGSW(0))) {
+        memset(arg, 0, size);
+        return (int)size;
+    }
+    if (nr == _IOC_NR(EVIOCGPROP(0))) {
+        memset(arg, 0, size);
+        return (int)size;
+    }
+    errno = EINVAL;
+    return -1;
+}
+
+static int js_ioctl(shim_fd_t *s, unsigned long req, void *arg)
+{
+    js_config_t *c = &s->cfg;
+    unsigned type = _IOC_TYPE(req), nr = _IOC_NR(req), size = _IOC_SIZE(req);
+    if (type != 'j') { errno = EINVAL; return -1; }
+
+    if (nr == _IOC_NR(JSIOCGVERSION)) { *(uint32_t *)arg = 0x020100; return 0; }
+    if (nr == _IOC_NR(JSIOCGAXES))    { *(uint8_t *)arg = (uint8_t)c->num_axes; return 0; }
+    if (nr == _IOC_NR(JSIOCGBUTTONS)) { *(uint8_t *)arg = (uint8_t)c->num_btns; return 0; }
+    if (nr == _IOC_NR(JSIOCGNAME(0))) {
+        size_t n = strnlen(c->name, NAME_LEN);
+        if (n >= size) n = size ? size - 1 : 0;
+        memcpy(arg, c->name, n);
+        ((char *)arg)[n] = 0;
+        return (int)n;
+    }
+    if (nr == _IOC_NR(JSIOCGAXMAP)) {
+        uint8_t *map = arg;
+        size_t cnt = size < MAX_AXES ? size : MAX_AXES;
+        for (size_t i = 0; i < cnt; i++)
+            map[i] = (uint8_t)(i < c->num_axes ? c->axes_map[i] : 0);
+        return 0;
+    }
+    if (nr == _IOC_NR(JSIOCGBTNMAP)) {
+        uint16_t *map = arg;
+        size_t cnt = size / 2 < MAX_BTNS ? size / 2 : MAX_BTNS;
+        for (size_t i = 0; i < cnt; i++)
+            map[i] = (uint16_t)(i < c->num_btns ? c->btn_map[i] : 0);
+        return 0;
+    }
+    if (nr == _IOC_NR(JSIOCGCORR)) {
+        memset(arg, 0, size);
+        return 0;
+    }
+    if (nr == _IOC_NR(JSIOCSCORR)) return 0;
+    errno = EINVAL;
+    return -1;
+}
+
+int ioctl(int fd, unsigned long req, ...)
+{
+    shim_init();
+    va_list ap;
+    va_start(ap, req);
+    void *arg = va_arg(ap, void *);
+    va_end(ap);
+
+    pthread_mutex_lock(&g_lock);
+    shim_fd_t *s = lookup(fd);
+    shim_fd_t copy;
+    if (s) copy = *s;
+    pthread_mutex_unlock(&g_lock);
+
+    if (!s) return real_ioctl(fd, req, arg);
+    return copy.is_evdev ? evdev_ioctl(&copy, req, arg)
+                         : js_ioctl(&copy, req, arg);
+}

@@ -264,6 +264,10 @@ class VirtualGamepad:
                 except OSError:
                     pass
 
+    @property
+    def client_count(self) -> int:
+        return len(self._js_clients) + len(self._ev_clients)
+
     # -- socket handling ---------------------------------------------------
 
     async def _on_client(self, reader: asyncio.StreamReader,

@@ -1,0 +1,11 @@
+"""Observability: Prometheus metrics + per-stage frame tracing.
+
+Parity targets: ``legacy/metrics.py`` (Prometheus gauges/histogram/Info on
+:8000, WebRTC-stats CSV dump) and the SURVEY §5 tracing gap (the reference
+has no tracer; we add per-stage timestamps around the encode path).
+"""
+
+from .metrics import Metrics
+from .tracing import FrameTracer, StageSpan
+
+__all__ = ["Metrics", "FrameTracer", "StageSpan"]

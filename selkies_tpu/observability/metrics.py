@@ -1,0 +1,108 @@
+"""Prometheus metrics endpoint.
+
+Parity with ``legacy/metrics.py:39-75``: ``fps`` gauge, ``fps_hist``
+histogram, ``gpu_utilization`` (here: TPU duty estimate), ``latency``
+gauge, and a ``webrtc_statistics`` Info — plus tpuenc-specific series
+(encode ms, stripe bytes, backpressure state). Falls back to a no-op
+registry when prometheus_client is unavailable so the server never grows
+a hard dependency.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+logger = logging.getLogger("selkies_tpu.observability.metrics")
+
+try:
+    import prometheus_client as prom
+    from prometheus_client import (CollectorRegistry, Gauge, Histogram, Info,
+                                   start_http_server)
+    HAVE_PROM = True
+except Exception:  # pragma: no cover
+    HAVE_PROM = False
+
+
+class Metrics:
+    def __init__(self, port: int = 8000):
+        self.port = port
+        self._started = False
+        if not HAVE_PROM:  # pragma: no cover
+            return
+        self.registry = CollectorRegistry()
+        self.fps = Gauge("fps", "Frames per second observed by client",
+                         registry=self.registry)
+        self.fps_hist = Histogram(
+            "fps_hist", "Histogram of FPS observed by client",
+            buckets=(0, 10, 20, 30, 40, 50, 60, 90, 120, float("inf")),
+            registry=self.registry)
+        self.latency = Gauge("latency", "Latency observed by client (ms)",
+                             registry=self.registry)
+        self.tpu_utilization = Gauge(
+            "tpu_utilization", "TPU encode duty cycle percent",
+            registry=self.registry)
+        self.gpu_utilization = Gauge(
+            "gpu_utilization", "Alias of tpu_utilization for dashboards "
+            "built against the reference", registry=self.registry)
+        self.encode_ms = Histogram(
+            "tpuenc_encode_ms", "Per-frame encode wall time (ms)",
+            buckets=(1, 2, 4, 8, 16, 33, 66, 100, float("inf")),
+            registry=self.registry)
+        self.frame_bytes = Histogram(
+            "tpuenc_frame_bytes", "Encoded bytes per frame",
+            buckets=(1e3, 5e3, 2e4, 5e4, 1e5, 2.5e5, 1e6, float("inf")),
+            registry=self.registry)
+        self.clients = Gauge("connected_clients", "WebSocket clients",
+                             registry=self.registry)
+        self.backpressured = Gauge(
+            "backpressured_displays", "Displays currently throttled by the "
+            "frame-ACK backpressure loop", registry=self.registry)
+        self.webrtc_stats = Info("webrtc_statistics", "Last WebRTC stats",
+                                 registry=self.registry)
+
+    def start_http(self) -> None:
+        """Expose /metrics (parity with legacy Metrics.start_http)."""
+        if HAVE_PROM and not self._started:
+            start_http_server(self.port, registry=self.registry)
+            self._started = True
+
+    # no-op-safe setters -------------------------------------------------
+
+    def set_fps(self, fps: float) -> None:
+        if HAVE_PROM:
+            self.fps.set(fps)
+            self.fps_hist.observe(fps)
+
+    def set_latency(self, ms: float) -> None:
+        if HAVE_PROM:
+            self.latency.set(ms)
+
+    def set_tpu_utilization(self, pct: float) -> None:
+        if HAVE_PROM:
+            self.tpu_utilization.set(pct)
+            self.gpu_utilization.set(pct)
+
+    def observe_encode(self, ms: float, nbytes: int) -> None:
+        if HAVE_PROM:
+            self.encode_ms.observe(ms)
+            self.frame_bytes.observe(nbytes)
+
+    def set_clients(self, n: int) -> None:
+        if HAVE_PROM:
+            self.clients.set(n)
+
+    def set_backpressured(self, n: int) -> None:
+        if HAVE_PROM:
+            self.backpressured.set(n)
+
+    def set_webrtc_stats(self, stats: Dict[str, str]) -> None:
+        if HAVE_PROM:
+            self.webrtc_stats.info(
+                {str(k): str(v) for k, v in stats.items()})
+
+    def render(self) -> bytes:
+        """Current exposition text (for tests / ad-hoc scraping)."""
+        if not HAVE_PROM:  # pragma: no cover
+            return b""
+        return prom.generate_latest(self.registry)

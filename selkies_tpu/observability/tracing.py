@@ -1,0 +1,87 @@
+"""Per-stage frame tracing: capture → stage → encode → fetch → send.
+
+The reference has no tracer (SURVEY §5 row 1: client-side FPS counting
+only). Here every frame can carry a ring of stage timestamps so tail
+latency is attributable: the dominant failure mode on accelerator-attached
+encode (dispatch queuing vs. D2H vs. websocket backpressure) is invisible
+to an end-to-end number.
+
+Zero-dependency and allocation-light: a fixed ring of float arrays; when
+jax profiling is wanted instead, wrap the block in
+``jax.profiler.trace`` externally.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+STAGES = ("capture", "stage", "dispatch", "harvest", "send")
+
+
+@dataclass
+class StageSpan:
+    frame_id: int
+    stamps: Dict[str, float] = field(default_factory=dict)
+
+    def mark(self, stage: str) -> None:
+        self.stamps[stage] = time.monotonic()
+
+    def duration_ms(self, a: str, b: str) -> Optional[float]:
+        if a in self.stamps and b in self.stamps:
+            return (self.stamps[b] - self.stamps[a]) * 1000.0
+        return None
+
+    @property
+    def total_ms(self) -> Optional[float]:
+        if not self.stamps:
+            return None
+        return (max(self.stamps.values()) - min(self.stamps.values())) * 1e3
+
+
+class FrameTracer:
+    """Ring buffer of recent frame spans + percentile summaries."""
+
+    def __init__(self, capacity: int = 600):
+        self.capacity = capacity
+        self._ring: List[StageSpan] = []
+        self._open: Dict[int, StageSpan] = {}
+
+    def begin(self, frame_id: int) -> StageSpan:
+        span = StageSpan(frame_id)
+        span.mark("capture")
+        self._open[frame_id] = span
+        return span
+
+    def mark(self, frame_id: int, stage: str) -> None:
+        span = self._open.get(frame_id)
+        if span is not None:
+            span.mark(stage)
+
+    def finish(self, frame_id: int) -> Optional[StageSpan]:
+        span = self._open.pop(frame_id, None)
+        if span is None:
+            return None
+        span.mark("send")
+        self._ring.append(span)
+        if len(self._ring) > self.capacity:
+            self._ring = self._ring[-self.capacity:]
+        return span
+
+    def percentile_ms(self, a: str, b: str, pct: float = 50.0) -> Optional[float]:
+        vals = sorted(
+            d for s in self._ring
+            if (d := s.duration_ms(a, b)) is not None)
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, int(len(vals) * pct / 100.0))
+        return vals[idx]
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        return {
+            "p50_total_ms": self.percentile_ms("capture", "send", 50),
+            "p95_total_ms": self.percentile_ms("capture", "send", 95),
+            "p50_encode_ms": self.percentile_ms("dispatch", "harvest", 50),
+            "frames": float(len(self._ring)),
+        }

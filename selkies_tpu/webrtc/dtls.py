@@ -222,6 +222,9 @@ class DtlsEndpoint:
         self._ecdh_priv = ec.generate_private_key(ec.SECP256R1())
         self._peer_pub: Optional[ec.EllipticCurvePublicKey] = None
         self._peer_cert_der: Optional[bytes] = None
+        self._peer_cert_verified = False
+        self._replay_highest: Dict[int, int] = {}   # epoch -> highest seq
+        self._replay_mask: Dict[int, int] = {}      # epoch -> 64-bit window
         self._master_secret: Optional[bytes] = None
         self._client_write_key = b""
         self._server_write_key = b""
@@ -282,11 +285,33 @@ class DtlsEndpoint:
             payload = datagram[pos + 13:pos + 13 + length]
             pos += 13 + length
             if epoch > 0:
+                if not self._replay_check(epoch, seq):
+                    continue
                 try:
                     payload = self._decrypt(ctype, epoch, seq, payload)
                 except Exception:
-                    continue  # bogus/replayed record
+                    continue  # bogus record
+                self._replay_update(epoch, seq)
             self._handle_record(ctype, payload)
+
+    def _replay_check(self, epoch: int, seq: int) -> bool:
+        """Sliding 64-entry anti-replay window (RFC 6347 §4.1.2.6)."""
+        highest = self._replay_highest.get(epoch)
+        if highest is None or seq > highest:
+            return True
+        delta = highest - seq
+        return delta < 64 and not (self._replay_mask.get(epoch, 0) >> delta) & 1
+
+    def _replay_update(self, epoch: int, seq: int) -> None:
+        highest = self._replay_highest.get(epoch)
+        mask = self._replay_mask.get(epoch, 0)
+        if highest is None or seq > highest:
+            shift = seq - highest if highest is not None else 1
+            mask = ((mask << shift) | 1) & ((1 << 64) - 1)
+            self._replay_highest[epoch] = seq
+        else:
+            mask |= 1 << (highest - seq)
+        self._replay_mask[epoch] = mask
 
     def _decrypt(self, ctype: int, epoch: int, seq: int, payload: bytes) -> bytes:
         key = self._client_write_key if not self.is_client else self._server_write_key
@@ -397,8 +422,6 @@ class DtlsEndpoint:
             body = bytes(slot["data"])
             full = _hs_header(slot["type"], slot["len"],
                               self._next_recv_msg_seq - 1) + body
-            if slot["type"] != HT_FINISHED:
-                pass
             try:
                 self._handle_handshake(slot["type"], body, full)
             except Exception as exc:  # protocol violation
@@ -537,6 +560,7 @@ class DtlsEndpoint:
             if total:
                 self._peer_cert_der = buf.read(buf.u24())
                 self._verify_peer_fingerprint()
+                self._peer_cert_verified = True
         elif msg_type == HT_SERVER_KEY_EXCHANGE and self.is_client:
             self._transcript += full_msg
             buf = _Buffer(body)
@@ -575,8 +599,18 @@ class DtlsEndpoint:
             peer_cert = x509.load_der_x509_certificate(self._peer_cert_der)
             peer_cert.public_key().verify(
                 sig, transcript_before, ec.ECDSA(hashes.SHA256()))
+            self._peer_key_proven = True
             self._transcript += full_msg
         elif msg_type == HT_FINISHED:
+            # mutual auth is mandatory when an SDP fingerprint was pinned:
+            # a peer that skipped Certificate/CertificateVerify must not
+            # complete the handshake (WebRTC requires client certs).
+            if self.remote_fingerprint is not None and \
+                    not self._peer_cert_verified:
+                raise ValueError("peer sent no certificate")
+            if not self.is_client and self.remote_fingerprint is not None \
+                    and not getattr(self, "_peer_key_proven", False):
+                raise ValueError("client sent no CertificateVerify")
             label = b"client finished" if not self.is_client \
                 else b"server finished"
             expect = prf(self._master_secret, label,

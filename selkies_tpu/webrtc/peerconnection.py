@@ -56,21 +56,33 @@ class MediaSender:
         self.octet_count = 0
         self._payloader = H264Payloader() if kind == "video" \
             else OpusPayloader()
+        self._last_rtp_ts: Optional[int] = None
+        self._last_send_wall: float = 0.0
 
     def send_frame(self, payload: bytes, timestamp: int) -> None:
         """Packetize + protect + ship one encoded frame/AU."""
         packets = self._payloader.packetize(
             payload, self.ssrc, self.payload_type, self.sequence, timestamp)
         self.sequence = (self.sequence + len(packets)) & 0xFFFF
+        self._last_rtp_ts = timestamp & 0xFFFFFFFF
+        self._last_send_wall = time.time()
         for pkt in packets:
             raw = pkt.serialize()
             self.packet_count += 1
             self.octet_count += len(pkt.payload)
             self.pc._send_rtp(raw)
 
-    def sender_report(self, ntp_time: int, rtp_time: int) -> RtcpSenderReport:
+    def sender_report(self, now_wall: float) -> Optional[RtcpSenderReport]:
+        """SR with an honest NTP↔RTP mapping: the receiver uses this pair
+        for A/V sync, so rtp_time must extrapolate the timestamps actually
+        stamped on media packets, not an unrelated clock."""
+        if self._last_rtp_ts is None:
+            return None
+        rtp_now = (self._last_rtp_ts + int(
+            (now_wall - self._last_send_wall) * self.clock_rate)) & 0xFFFFFFFF
+        ntp = int((now_wall + 2208988800) * (1 << 32)) & 0xFFFFFFFFFFFFFFFF
         return RtcpSenderReport(
-            ssrc=self.ssrc, ntp_time=ntp_time, rtp_time=rtp_time,
+            ssrc=self.ssrc, ntp_time=ntp, rtp_time=rtp_now,
             packet_count=self.packet_count, octet_count=self.octet_count)
 
 
@@ -371,9 +383,12 @@ class PeerConnection:
             pass
 
     def _send_sender_reports(self, now: float) -> None:
-        ntp = int((now + 2208988800) * (1 << 32)) & 0xFFFFFFFFFFFFFFFF
+        del now  # monotonic; SR mapping needs the wall clock
+        wall = time.time()
         for s in self.senders.values():
-            sr = s.sender_report(ntp, int(now * s.clock_rate) & 0xFFFFFFFF)
+            sr = s.sender_report(wall)
+            if sr is None:
+                continue
             try:
                 self.ice.send(self.srtp_tx.protect_rtcp(sr.serialize()))
             except (ConnectionError, ValueError):

@@ -13,8 +13,9 @@ Subset implemented (sufficient for browser data channels):
   - DCEP DATA_CHANNEL_OPEN / ACK (PPID 50) and string (51) / binary (53)
     payloads; empty-string (56) / empty-binary (57) map to b"".
 
-Congestion control is a fixed flight-size cap — desktop-streaming input
-channels move tiny messages; media rides SRTP, not SCTP.
+There is no congestion window: desktop-streaming input channels move tiny
+messages (media rides SRTP, not SCTP), and RTO-based retransmission with
+endpoint-failure abort bounds the in-flight set.
 """
 
 from __future__ import annotations
@@ -23,8 +24,7 @@ import logging
 import os
 import struct
 import time
-import zlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("selkies_tpu.webrtc.sctp")
@@ -61,7 +61,6 @@ CHANNEL_PARTIAL_RELIABLE_TIMED = 0x02
 CHANNEL_UNORDERED_FLAG = 0x80
 
 MTU = 1150
-MAX_FLIGHT = 32
 RTO = 0.5
 
 
@@ -149,7 +148,6 @@ class SctpAssociation:
 
         self._ssn: Dict[int, int] = {}
         self._reasm: Dict[Tuple[int, int], List] = {}
-        self._recv_frags: List = []
         self._out: Dict[int, _OutChunk] = {}
         self._recv_tsns: set = set()
         self._next_even_odd = 0 if is_client else 1
@@ -214,8 +212,14 @@ class SctpAssociation:
                 chunk.retransmits += 1
                 chunk.sent_at = now
                 if chunk.retransmits > 8:
-                    del self._out[chunk.tsn]
-                    continue
+                    # RFC 4960 §8.1: endpoint failure — a reliable channel
+                    # must not silently turn best-effort
+                    logger.error("SCTP peer unreachable after %d "
+                                 "retransmits; aborting association",
+                                 chunk.retransmits)
+                    self.state = "closed"
+                    self._out.clear()
+                    return
                 self._send_packet([chunk.data])
 
     # ----------------------------------------------------------- receive
@@ -338,6 +342,10 @@ class SctpAssociation:
             return
         tsn, sid, ssn, ppid = struct.unpack_from("!IHHI", body)
         payload = body[12:]
+        # at/below the cumulative ack = already delivered (the TSN set is
+        # pruned there, so this guard is what stops SACK-loss re-delivery)
+        if self._seen_first and not tsn_gt(tsn, self.cum_ack):
+            return
         if tsn in self._recv_tsns:
             return
         self._recv_tsns.add(tsn)
@@ -351,10 +359,13 @@ class SctpAssociation:
         else:
             frags = self._reasm.setdefault(key, [])
             frags.append((tsn, begin, end, payload))
-            frags.sort(key=lambda f: f[0])
+            # serial sort robust to the 32-bit wrap: all fragments of one
+            # message lie within a tiny TSN span, so distances measured
+            # from (any member - 2^31) are monotone with no discontinuity
+            base = (frags[0][0] - 0x80000000) & 0xFFFFFFFF
+            frags.sort(key=lambda f: (f[0] - base) & 0xFFFFFFFF)
             if frags[0][1] and frags[-1][2] and \
-                    all(tsn_gt(frags[i + 1][0], frags[i][0])
-                        and ((frags[i + 1][0] - frags[i][0]) & 0xFFFFFFFF) == 1
+                    all(((frags[i + 1][0] - frags[i][0]) & 0xFFFFFFFF) == 1
                         for i in range(len(frags) - 1)):
                 whole = b"".join(f[3] for f in frags)
                 del self._reasm[key]

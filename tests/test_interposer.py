@@ -1,0 +1,190 @@
+"""C joystick-interposer integration: LD_PRELOAD subprocess opens
+/dev/input/js0, queries ioctls, and reads a live event from the
+VirtualGamepad unix-socket server.
+
+Parity target: addons/js-interposer + its manual js-interposer-test.py
+harness in the reference (SURVEY.md §2.2, §4) — here automated."""
+
+import asyncio
+import os
+import shutil
+import struct
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC_DIR = os.path.join(ROOT, "native", "interposer")
+SHIM = os.path.join(SRC_DIR, "selkies_joystick_interposer.so")
+
+
+def build_shim():
+    if os.path.exists(SHIM):
+        return True
+    if shutil.which("make") is None or shutil.which("cc") is None:
+        return False
+    r = subprocess.run(["make", "-C", SRC_DIR], capture_output=True)
+    return r.returncode == 0 and os.path.exists(SHIM)
+
+
+CHILD_SCRIPT = textwrap.dedent("""
+    import fcntl, os, struct, sys
+    fd = os.open("/dev/input/js0", os.O_RDONLY)
+    # JSIOCGAXES / JSIOCGBUTTONS / JSIOCGNAME(128)
+    buf = bytearray(1)
+    fcntl.ioctl(fd, 0x80016a11, buf)       # JSIOCGAXES
+    axes = buf[0]
+    buf = bytearray(1)
+    fcntl.ioctl(fd, 0x80016a12, buf)       # JSIOCGBUTTONS
+    btns = buf[0]
+    name = bytearray(128)
+    fcntl.ioctl(fd, 0x80806a13, name)      # JSIOCGNAME(128)
+    name = name.split(b"\\0")[0].decode()
+    ev = os.read(fd, 8)                     # one js_event
+    t_ms, value, etype, num = struct.unpack("=IhBB", ev)
+    print(f"{axes} {btns} {etype} {num} {value} {name}")
+    os.close(fd)
+""")
+
+
+@pytest.mark.skipif(not build_shim(), reason="C toolchain unavailable")
+def test_interposer_end_to_end(tmp_path):
+    from selkies_tpu.input.gamepad import VirtualGamepad
+
+    async def run():
+        pad = VirtualGamepad(0, socket_dir=str(tmp_path))
+        await pad.start()
+
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = SHIM
+        env["SELKIES_INTERPOSER_SOCKET_DIR"] = str(tmp_path)
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-c", CHILD_SCRIPT,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+        # wait for the child to connect, then press button A
+        for _ in range(100):
+            if pad.client_count:
+                break
+            await asyncio.sleep(0.05)
+        assert pad.client_count, "child never connected through the shim"
+        pad.send_button(0, 1.0)
+
+        out, err = await asyncio.wait_for(proc.communicate(), 15)
+        assert proc.returncode == 0, err.decode()
+        axes, btns, etype, num, value, name = out.decode().split(None, 5)
+        assert int(axes) == 8
+        assert int(btns) == 11
+        assert "X-Box 360" in name
+        assert int(etype) == 1      # JS_EVENT_BUTTON
+        assert int(num) == 0 and int(value) == 1
+        await pad.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.skipif(not build_shim(), reason="C toolchain unavailable")
+def test_interposer_evdev_ioctls(tmp_path):
+    from selkies_tpu.input.gamepad import VirtualGamepad
+
+    child = textwrap.dedent("""
+        import fcntl, os
+        fd = os.open("/dev/input/event1000", os.O_RDONLY)
+        ver = bytearray(4)
+        fcntl.ioctl(fd, 0x80044501, ver)     # EVIOCGVERSION
+        iid = bytearray(8)
+        fcntl.ioctl(fd, 0x80084502, iid)     # EVIOCGID
+        import struct
+        bus, vid, pid, rev = struct.unpack("=HHHH", iid)
+        name = bytearray(64)
+        fcntl.ioctl(fd, 0x80404506, name)    # EVIOCGNAME(64)
+        print(hex(vid), hex(pid), name.split(b"\\0")[0].decode())
+        os.close(fd)
+    """)
+
+    async def run():
+        pad = VirtualGamepad(0, socket_dir=str(tmp_path))
+        await pad.start()
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = SHIM
+        env["SELKIES_INTERPOSER_SOCKET_DIR"] = str(tmp_path)
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-c", child,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        out, err = await asyncio.wait_for(proc.communicate(), 15)
+        assert proc.returncode == 0, err.decode()
+        vid, pid, name = out.decode().split(None, 2)
+        assert vid == "0x45e" and pid == "0x28e"
+        assert "X-Box 360" in name
+        await pad.stop()
+
+    asyncio.run(run())
+
+
+FAKE_UDEV_DIR = os.path.join(ROOT, "native", "fake-udev")
+FAKE_UDEV = os.path.join(FAKE_UDEV_DIR, "libudev.so.1.0.0-fake")
+
+UDEV_TEST_C = os.path.join(FAKE_UDEV_DIR, ".test_udev.c")
+
+UDEV_TEST_SRC = r'''
+#include <stdio.h>
+#include <string.h>
+struct udev; struct udev_enumerate; struct udev_list_entry;
+struct udev_device; struct udev_monitor;
+extern struct udev *udev_new(void);
+extern struct udev_enumerate *udev_enumerate_new(struct udev *);
+extern int udev_enumerate_add_match_subsystem(struct udev_enumerate *, const char *);
+extern int udev_enumerate_scan_devices(struct udev_enumerate *);
+extern struct udev_list_entry *udev_enumerate_get_list_entry(struct udev_enumerate *);
+extern struct udev_list_entry *udev_list_entry_get_next(struct udev_list_entry *);
+extern const char *udev_list_entry_get_name(struct udev_list_entry *);
+extern struct udev_device *udev_device_new_from_syspath(struct udev *, const char *);
+extern const char *udev_device_get_devnode(struct udev_device *);
+extern const char *udev_device_get_property_value(struct udev_device *, const char *);
+int main(void) {
+    struct udev *u = udev_new();
+    struct udev_enumerate *e = udev_enumerate_new(u);
+    udev_enumerate_add_match_subsystem(e, "input");
+    udev_enumerate_scan_devices(e);
+    int n = 0, joy = 0;
+    struct udev_list_entry *ent = udev_enumerate_get_list_entry(e);
+    for (; ent; ent = udev_list_entry_get_next(ent)) {
+        struct udev_device *d =
+            udev_device_new_from_syspath(u, udev_list_entry_get_name(ent));
+        const char *j = udev_device_get_property_value(d, "ID_INPUT_JOYSTICK");
+        const char *node = udev_device_get_devnode(d);
+        if (node && j && !strcmp(j, "1")) joy++;
+        n++;
+    }
+    printf("%d %d\n", n, joy);
+    return 0;
+}
+'''
+
+
+def build_fake_udev():
+    if not os.path.exists(FAKE_UDEV):
+        if shutil.which("make") is None or shutil.which("cc") is None:
+            return False
+        r = subprocess.run(["make", "-C", FAKE_UDEV_DIR], capture_output=True)
+        if r.returncode != 0:
+            return False
+    return os.path.exists(FAKE_UDEV)
+
+
+@pytest.mark.skipif(not build_fake_udev(), reason="C toolchain unavailable")
+def test_fake_udev_enumeration(tmp_path):
+    src = tmp_path / "t.c"
+    src.write_text(UDEV_TEST_SRC)
+    binary = tmp_path / "t"
+    r = subprocess.run(["cc", "-o", str(binary), str(src), FAKE_UDEV],
+                       capture_output=True)
+    assert r.returncode == 0, r.stderr.decode()
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = FAKE_UDEV
+    out = subprocess.run([str(binary)], env=env, capture_output=True)
+    assert out.returncode == 0
+    n, joy = out.stdout.split()
+    assert (int(n), int(joy)) == (8, 8)

@@ -1,0 +1,49 @@
+"""Metrics + tracing tests (parity: legacy/metrics.py gauges/histograms)."""
+
+import time
+
+from selkies_tpu.observability import FrameTracer, Metrics
+
+
+def test_metrics_render():
+    m = Metrics(port=0)
+    m.set_fps(60.0)
+    m.set_latency(12.5)
+    m.set_tpu_utilization(45.0)
+    m.observe_encode(8.0, 50_000)
+    m.set_clients(3)
+    m.set_backpressured(1)
+    m.set_webrtc_stats({"bitrate": "8000000"})
+    text = m.render().decode()
+    assert "fps 60.0" in text
+    assert "latency 12.5" in text
+    assert "tpu_utilization 45.0" in text
+    assert "gpu_utilization 45.0" in text      # reference-compatible alias
+    assert "connected_clients 3.0" in text
+    assert 'webrtc_statistics_info{bitrate="8000000"}' in text
+    assert "tpuenc_encode_ms_bucket" in text
+
+
+def test_frame_tracer_percentiles():
+    tr = FrameTracer(capacity=100)
+    for fid in range(10):
+        span = tr.begin(fid)
+        span.stamps["capture"] = 0.0
+        span.stamps["dispatch"] = 0.001
+        span.stamps["harvest"] = 0.001 + 0.001 * (fid + 1)
+        tr.finish(fid)
+        span.stamps["send"] = span.stamps["harvest"] + 0.0005
+    s = tr.summary()
+    assert s["frames"] == 10
+    assert 1.0 <= s["p50_encode_ms"] <= 10.5
+    p95 = tr.percentile_ms("dispatch", "harvest", 95)
+    assert p95 >= s["p50_encode_ms"]
+
+
+def test_frame_tracer_ring_bound():
+    tr = FrameTracer(capacity=5)
+    for fid in range(20):
+        tr.begin(fid)
+        tr.finish(fid)
+    assert tr.summary()["frames"] == 5
+    assert tr.finish(999) is None

@@ -1,0 +1,146 @@
+"""WebRTC streaming-mode integration: app ↔ signaling server ↔ fake
+browser peer, full media + input over the in-repo stack on loopback UDP.
+
+Parity target: the reference's legacy session flow
+(webrtc.py on_session → gstwebrtc_app start_pipeline → webrtcbin offer →
+browser answer → media + "input" data channel)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from selkies_tpu.rtc import SignalingServer, SignalingClient
+from selkies_tpu.server.webrtc_app import WebRTCStreamingApp, bitrate_to_qp
+from selkies_tpu.webrtc.peerconnection import PeerConnection
+
+
+class FakeEncoder:
+    """Stands in for the TPU H.264 encoder (jit-free for CPU CI)."""
+
+    def __init__(self):
+        self.qp = 26
+        self.keyframes_requested = 0
+        self._n = 0
+
+    def encode_frame(self, rgb):
+        self._n += 1
+        class S:
+            pass
+        s = S()
+        s.annexb = (b"\x00\x00\x00\x01\x67\x42\x00\x28"
+                    b"\x00\x00\x00\x01\x65" + bytes([self._n & 0xFF]) * 500)
+        s.is_key = True
+        return [s]
+
+    def request_keyframe(self):
+        self.keyframes_requested += 1
+
+
+class FakeSource:
+    def __init__(self, w, h, fps):
+        self.w, self.h = w, h
+
+    def next_frame(self):
+        return np.zeros((self.h, self.w, 3), np.uint8)
+
+
+class RecordingInput:
+    def __init__(self):
+        self.messages = []
+
+    def on_message(self, msg):
+        self.messages.append(msg)
+
+
+class Settings:
+    initial_width = 320
+    initial_height = 240
+    framerate = 30
+
+
+def test_bitrate_to_qp_monotone():
+    assert bitrate_to_qp(8_000_000) == 26
+    assert bitrate_to_qp(2_000_000) > bitrate_to_qp(8_000_000)
+    assert bitrate_to_qp(64_000_000) < bitrate_to_qp(8_000_000)
+    assert 18 <= bitrate_to_qp(100) <= 46
+    assert bitrate_to_qp(0) == 46
+
+
+def test_webrtc_app_full_session():
+    async def run():
+        # 1. signaling server
+        server = SignalingServer(addr="127.0.0.1", port=0)
+        stask = asyncio.create_task(server.run())
+        for _ in range(100):
+            if server.server is not None:
+                break
+            await asyncio.sleep(0.01)
+        uri = f"ws://127.0.0.1:{server.port}/ws"
+
+        # 2. fake browser: registers as peer "1", answers the offer
+        browser_pc = PeerConnection(interfaces=["127.0.0.1"])
+        got_frames = []
+        browser_pc.video_receiver().on_frame = \
+            lambda f, ts: got_frames.append(f)
+        opened = {}
+
+        def on_channel(ch):
+            opened["ch"] = ch
+        browser_pc.on_channel = on_channel
+
+        browser_sig = SignalingClient(uri, "1")
+
+        async def browser_on_sdp(sdp_type, sdp):
+            assert sdp_type == "offer"
+            await browser_pc.set_remote_description(sdp, "offer")
+            answer = await browser_pc.create_answer()
+            await browser_sig.send_sdp("answer", answer)
+        browser_sig.on_sdp = browser_on_sdp
+        await browser_sig.connect()
+        btask = asyncio.create_task(browser_sig.start())
+
+        # 3. streaming app: registers as "0", calls peer "1"
+        recorder = RecordingInput()
+        app = WebRTCStreamingApp(
+            Settings(),
+            encoder_factory=lambda w, h: FakeEncoder(),
+            source_factory=lambda w, h, fps: FakeSource(w, h, fps),
+            input_handler=recorder,
+            interfaces=["127.0.0.1"])
+        atask = asyncio.create_task(app.run(uri, "0", "1"))
+
+        # 4. media flows
+        for _ in range(300):
+            if len(got_frames) >= 3:
+                break
+            await asyncio.sleep(0.05)
+        assert len(got_frames) >= 3, "no video frames arrived"
+        assert got_frames[0].startswith(b"\x00\x00\x00\x01\x67")
+
+        # 5. input channel: browser → app
+        for _ in range(200):
+            if "ch" in opened and opened["ch"].open:
+                break
+            await asyncio.sleep(0.05)
+        assert "ch" in opened
+        browser_pc.sctp.send(opened["ch"], "kd,65")
+        browser_pc.sctp.send(opened["ch"], "m,10,20,0,0")
+        for _ in range(100):
+            if len(recorder.messages) >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert recorder.messages == ["kd,65", "m,10,20,0,0"]
+
+        # 6. congestion feedback adjusts QP
+        app.set_video_bitrate(1_000_000)
+        assert app.encoder.qp == bitrate_to_qp(1_000_000)
+
+        await app.stop_pipeline()
+        await browser_pc.close()
+        await browser_sig.stop()
+        await server.stop()
+        for t in (stask, btask, atask):
+            t.cancel()
+
+    asyncio.run(run())
