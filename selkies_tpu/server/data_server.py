@@ -127,6 +127,7 @@ class DataStreamingServer:
         self._server = None
         self._stop_event: Optional[asyncio.Event] = None
         self.bytes_sent = 0
+        self.metrics = None         # wired by main() when prometheus is up
         self.audio_pipeline = None  # wired by main() when audio is enabled
         self._audio_wanted = True   # cleared by STOP_AUDIO until re-requested
         self._last_layout = None    # last xrandr-applied Layout (dedup)
@@ -188,6 +189,8 @@ class DataStreamingServer:
 
     async def ws_handler(self, websocket) -> None:
         self.clients.add(websocket)
+        if self.metrics is not None:
+            self.metrics.set_clients(len(self.clients))
         try:
             if (self.audio_pipeline is not None and self._audio_wanted
                     and not self.audio_pipeline.running):
@@ -208,6 +211,8 @@ class DataStreamingServer:
             logger.debug("ws session ended: %r", e)
         finally:
             self.clients.discard(websocket)
+            if self.metrics is not None:
+                self.metrics.set_clients(len(self.clients))
             self._uploads.pop(websocket, None)
             dropped = False
             for st in list(self.display_clients.values()):
@@ -284,9 +289,17 @@ class DataStreamingServer:
                 st = self._display_of(websocket)
                 if st and msg.args:
                     try:
-                        st.bp.on_client_fps(float(msg.args[0]))
+                        fps = float(msg.args[0])
+                        st.bp.on_client_fps(fps)
+                        if self.metrics is not None:
+                            self.metrics.set_fps(fps)
                     except ValueError:
                         pass
+            elif verb == "_l" and msg.args and self.metrics is not None:
+                try:
+                    self.metrics.set_latency(float(msg.args[0]))
+                except ValueError:
+                    pass
             if self.input_handler is not None:
                 await self.input_handler.on_message(
                     message, self._display_id_of(websocket))

@@ -63,6 +63,55 @@ async def _amain(settings: Settings) -> int:
         logging.getLogger("selkies_tpu").warning("input plane disabled: %s", e)
 
     tasks = [asyncio.create_task(server.run_server())]
+
+    # HTTP side: serve the bundled web client + /turn + signaling on the
+    # web port (reference: signalling_web.py serves gst-web on 8080)
+    web_server = None
+    try:
+        import os
+
+        from ..rtc import SignalingServer
+
+        web_root = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "web")
+        if os.path.isdir(web_root):
+            web_server = SignalingServer(
+                addr="0.0.0.0", port=int(settings.web_port),
+                web_root=web_root,
+                turn_shared_secret=str(settings.turn_shared_secret),
+                turn_host=str(settings.turn_host),
+                turn_port=str(settings.turn_port),
+            )
+
+            async def _run_web(ws=web_server):
+                # a busy web port must not take the media plane down
+                try:
+                    await ws.run()
+                except OSError as e:
+                    logging.getLogger("selkies_tpu").error(
+                        "web server bind failed (%s); client serving "
+                        "disabled", e)
+
+            tasks.append(asyncio.create_task(_run_web()))
+        else:
+            logging.getLogger("selkies_tpu").warning(
+                "web client assets not found at %s; HTTP serving disabled",
+                web_root)
+    except Exception:
+        logging.getLogger("selkies_tpu").exception("web server init failed")
+
+    metrics = None
+    try:
+        from ..observability import Metrics
+
+        if int(settings.metrics_port) > 0:
+            metrics = Metrics(port=int(settings.metrics_port))
+            metrics.start_http()
+            server.metrics = metrics
+    except Exception as e:
+        logging.getLogger("selkies_tpu").warning("metrics disabled: %s", e)
+
     if input_handler is not None:
         tasks.append(asyncio.create_task(input_handler.run_clipboard_poll()))
     if cursor_monitor is not None:
@@ -72,6 +121,8 @@ async def _amain(settings: Settings) -> int:
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        if web_server is not None:
+            await web_server.stop()
         if cursor_monitor is not None:
             cursor_monitor.stop()
             cursor_monitor.source.close()

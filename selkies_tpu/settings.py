@@ -269,6 +269,16 @@ SETTING_DEFINITIONS: List[Spec] = [
     IntSpec("max_upload_mb", 4096, "Absolute per-file upload cap in MiB "
             "(enforced regardless of the client-declared size).",
             server_only=True),
+    IntSpec("web_port", 8080, "HTTP port for the web client + signaling "
+            "(reference signalling_web.py default).", server_only=True),
+    IntSpec("metrics_port", 8000, "Prometheus metrics port (0 disables; "
+            "reference legacy/metrics.py default).", server_only=True),
+    StrSpec("turn_host", "", "TURN server hostname for /turn credentials.",
+            legacy_env="TURN_HOST", server_only=True),
+    StrSpec("turn_port", "3478", "TURN server port.",
+            legacy_env="TURN_PORT", server_only=True),
+    StrSpec("turn_shared_secret", "", "coturn shared secret for HMAC "
+            "credentials.", legacy_env="TURN_SHARED_SECRET", server_only=True),
 
     # Sharing
     BoolSpec("enable_sharing", True, "Master sharing toggle."),

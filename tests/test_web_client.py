@@ -1,0 +1,90 @@
+"""Web client contract tests.
+
+No JS runtime exists in this image, so the client is validated against
+the wire-protocol contract structurally: the demux branches, verbs, and
+frame layouts it implements must match selkies_tpu/protocol/wire.py, and
+the server must actually serve it over HTTP."""
+
+import asyncio
+import os
+import re
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WEB = os.path.join(ROOT, "web")
+
+
+def read(name):
+    with open(os.path.join(WEB, name)) as f:
+        return f.read()
+
+
+def test_client_implements_binary_demux():
+    js = read("selkies-client.js")
+    # all four server->client binary types are demuxed
+    for t in ("0x00", "0x01", "0x03", "0x04"):
+        assert re.search(rf"case {t}:", js), f"missing demux for {t}"
+    # header offsets match wire.py: frame_id at 2, y_start at 4,
+    # JPEG payload at 6, H.264 stripe payload at 10, full-frame at 4
+    assert "subarray(6)" in js     # JPEG stripe payload
+    assert "subarray(10)" in js    # H.264 stripe payload
+    assert "subarray(4)" in js     # full-frame payload
+    assert "subarray(2)" in js     # audio payload
+
+
+def test_client_speaks_protocol_verbs():
+    js = read("selkies-client.js")
+    for verb in ("SETTINGS,", "CLIENT_FRAME_ACK", "PIPELINE_RESETTING",
+                 "FILE_UPLOAD_START", "FILE_UPLOAD_END", "START_VIDEO",
+                 "STOP_VIDEO", "cw,", "cr", "_f "):
+        assert verb in js, f"client missing verb {verb!r}"
+    # client->server binary framing: file chunk 0x01, mic 0x02
+    assert "framed[0] = 0x01" in js
+    assert "framed[0] = 0x02" in js
+
+
+def test_input_speaks_protocol_verbs():
+    js = read("input.js")
+    for verb in ('"kd,"', '"ku,"', '"kr"', "js,c", "js,b", "js,a", "js,d"):
+        assert verb.strip('"') in js.replace('"', ""), f"missing {verb}"
+    assert "m2," in js and "m," in js
+    # X11 unicode keysym rule
+    assert "0x01000000" in js
+    # keysym table sanity: essential keys present
+    for key in ("Backspace: 0xff08", "Enter: 0xff0d", "Escape: 0xff1b",
+                "Shift: 0xffe1", "F12: 0xffc9"):
+        assert key in js
+
+
+def test_index_wires_modules():
+    html = read("index.html")
+    assert "selkies-client.js" in html
+    assert "input.js" in html
+    assert "SelkiesClient" in html and "SelkiesInput" in html
+
+
+def test_web_root_served_over_http():
+    from selkies_tpu.rtc import SignalingServer
+
+    async def run():
+        server = SignalingServer(addr="127.0.0.1", port=0, web_root=WEB)
+        task = asyncio.create_task(server.run())
+        for _ in range(100):
+            if server.server is not None:
+                break
+            await asyncio.sleep(0.01)
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}{path}") as r:
+                return r.status, r.read(), r.headers.get("Content-Type")
+
+        status, body, ctype = await asyncio.to_thread(get, "/")
+        assert status == 200 and b"selkies-tpu" in body
+        status, body, ctype = await asyncio.to_thread(get, "/selkies-client.js")
+        assert status == 200 and b"SelkiesClient" in body
+        assert "javascript" in ctype
+        await server.stop()
+        task.cancel()
+
+    asyncio.run(run())

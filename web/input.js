@@ -1,0 +1,179 @@
+/*
+ * input.js — browser input capture → selkies wire messages.
+ *
+ * Role parity with the reference's addons/gst-web-core/lib/input.js
+ * (Guacamole-derived, 2,505 LoC): keyboard → X11 keysyms ("kd,"/"ku,"),
+ * pointer/touch → "m," absolute / "m2," relative (pointer lock), wheel,
+ * gamepad polling → "js,c/b/a/d" messages. Fresh, compact implementation:
+ * printable keys map through the X11 rule (latin-1 keysym = codepoint,
+ * others 0x01000000+codepoint); non-printables through an explicit table.
+ */
+
+"use strict";
+
+const KEY_TO_KEYSYM = {
+  Backspace: 0xff08, Tab: 0xff09, Enter: 0xff0d, Escape: 0xff1b,
+  Delete: 0xffff, Home: 0xff50, End: 0xff57, PageUp: 0xff55,
+  PageDown: 0xff56, ArrowLeft: 0xff51, ArrowUp: 0xff52,
+  ArrowRight: 0xff53, ArrowDown: 0xff54, Insert: 0xff63,
+  F1: 0xffbe, F2: 0xffbf, F3: 0xffc0, F4: 0xffc1, F5: 0xffc2,
+  F6: 0xffc3, F7: 0xffc4, F8: 0xffc5, F9: 0xffc6, F10: 0xffc7,
+  F11: 0xffc8, F12: 0xffc9, Shift: 0xffe1, Control: 0xffe3,
+  Alt: 0xffe9, AltGraph: 0xffea, Meta: 0xffe7, CapsLock: 0xffe5,
+  NumLock: 0xff7f, ScrollLock: 0xff14, Pause: 0xff13,
+  PrintScreen: 0xff61, ContextMenu: 0xff67,
+};
+
+const CODE_TO_KEYSYM_RIGHT = {
+  ShiftRight: 0xffe2, ControlRight: 0xffe4, AltRight: 0xffea,
+  MetaRight: 0xffe8,
+};
+
+function eventKeysym(ev) {
+  if (ev.key && ev.key.length === 1) {
+    const cp = ev.key.codePointAt(0);
+    if (cp < 0x100) return cp;                  // latin-1 direct
+    return 0x01000000 + cp;                     // X11 unicode rule
+  }
+  if (ev.code in CODE_TO_KEYSYM_RIGHT) return CODE_TO_KEYSYM_RIGHT[ev.code];
+  if (ev.key in KEY_TO_KEYSYM) return KEY_TO_KEYSYM[ev.key];
+  return null;
+}
+
+class SelkiesInput {
+  constructor(client, element) {
+    this.client = client;
+    this.el = element;
+    this.buttonMask = 0;
+    this.pointerLocked = false;
+    this.gamepadTimer = null;
+    this.gamepadState = new Map();   // index -> {buttons:[], axes:[]}
+    this._handlers = [];
+  }
+
+  attach() {
+    const on = (target, type, fn, opts) => {
+      target.addEventListener(type, fn, opts);
+      this._handlers.push([target, type, fn, opts]);
+    };
+    on(window, "keydown", (e) => this._key(e, true));
+    on(window, "keyup", (e) => this._key(e, false));
+    on(window, "blur", () => this.client.send("kr"));
+    on(this.el, "mousemove", (e) => this._motion(e));
+    on(this.el, "mousedown", (e) => this._button(e, true));
+    on(this.el, "mouseup", (e) => this._button(e, false));
+    on(this.el, "wheel", (e) => this._wheel(e), { passive: false });
+    on(this.el, "contextmenu", (e) => e.preventDefault());
+    on(document, "pointerlockchange",
+       () => { this.pointerLocked = document.pointerLockElement === this.el; });
+    on(window, "gamepadconnected", (e) => this._gamepadConnected(e));
+    on(window, "gamepaddisconnected", (e) => this._gamepadDisconnected(e));
+  }
+
+  detach() {
+    for (const [t, type, fn, opts] of this._handlers) {
+      t.removeEventListener(type, fn, opts);
+    }
+    this._handlers = [];
+    if (this.gamepadTimer) clearInterval(this.gamepadTimer);
+  }
+
+  requestPointerLock() { this.el.requestPointerLock(); }
+
+  /* -------------------------------------------------------- keyboard */
+
+  _key(ev, down) {
+    const keysym = eventKeysym(ev);
+    if (keysym === null) return;
+    ev.preventDefault();
+    this.client.send((down ? "kd," : "ku,") + keysym);
+  }
+
+  /* ----------------------------------------------------------- mouse */
+
+  _canvasCoords(ev) {
+    const rect = this.el.getBoundingClientRect();
+    const sx = this.el.width / rect.width;
+    const sy = this.el.height / rect.height;
+    return [Math.round((ev.clientX - rect.left) * sx),
+            Math.round((ev.clientY - rect.top) * sy)];
+  }
+
+  _motion(ev) {
+    if (this.pointerLocked) {
+      this.client.send(`m2,${ev.movementX},${ev.movementY},${this.buttonMask},0`);
+    } else {
+      const [x, y] = this._canvasCoords(ev);
+      this.client.send(`m,${x},${y},${this.buttonMask},0`);
+    }
+  }
+
+  _button(ev, down) {
+    ev.preventDefault();
+    const bit = 1 << ev.button;
+    if (down) this.buttonMask |= bit;
+    else this.buttonMask &= ~bit;
+    this._motion(ev);
+  }
+
+  _wheel(ev) {
+    ev.preventDefault();
+    // scroll bits ride the mask like the reference: bit 3 up, bit 4 down
+    const scrollBit = ev.deltaY < 0 ? 8 : 16;
+    const magnitude = Math.min(15, Math.max(1,
+      Math.round(Math.abs(ev.deltaY) / 40)));
+    const [x, y] = this.pointerLocked ? [0, 0] : this._canvasCoords(ev);
+    const prefix = this.pointerLocked ? "m2" : "m";
+    this.client.send(
+      `${prefix},${x},${y},${this.buttonMask | scrollBit},${magnitude}`);
+  }
+
+  /* --------------------------------------------------------- gamepad */
+
+  _gamepadConnected(ev) {
+    const gp = ev.gamepad;
+    this.client.send(
+      `js,c,${gp.index},${btoa(gp.id).slice(0, 32)},` +
+      `${gp.buttons.length},${gp.axes.length}`);
+    this.gamepadState.set(gp.index, {
+      buttons: gp.buttons.map((b) => b.value),
+      axes: gp.axes.slice(),
+    });
+    if (!this.gamepadTimer) {
+      this.gamepadTimer = setInterval(() => this._pollGamepads(), 16);
+    }
+  }
+
+  _gamepadDisconnected(ev) {
+    this.client.send(`js,d,${ev.gamepad.index}`);
+    this.gamepadState.delete(ev.gamepad.index);
+    if (!this.gamepadState.size && this.gamepadTimer) {
+      clearInterval(this.gamepadTimer);
+      this.gamepadTimer = null;
+    }
+  }
+
+  _pollGamepads() {
+    for (const gp of navigator.getGamepads()) {
+      if (!gp) continue;
+      const prev = this.gamepadState.get(gp.index);
+      if (!prev) continue;
+      gp.buttons.forEach((b, i) => {
+        if (b.value !== prev.buttons[i]) {
+          prev.buttons[i] = b.value;
+          this.client.send(`js,b,${gp.index},${i},${b.value.toFixed(3)}`);
+        }
+      });
+      gp.axes.forEach((v, i) => {
+        if (Math.abs(v - prev.axes[i]) > 0.01) {
+          prev.axes[i] = v;
+          this.client.send(`js,a,${gp.index},${i},${v.toFixed(3)}`);
+        }
+      });
+    }
+  }
+}
+
+if (typeof module !== "undefined") {
+  module.exports = { SelkiesInput, eventKeysym, KEY_TO_KEYSYM };
+}

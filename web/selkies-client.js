@@ -1,0 +1,383 @@
+/*
+ * selkies-client.js — browser client core for the selkies-tpu streaming
+ * server (websockets mode).
+ *
+ * Role parity with the reference's addons/gst-web-core/selkies-core.js
+ * (4,207 LoC): WebSocket connect + SETTINGS handshake, binary demux by
+ * first byte (0x00 full-frame H.264 → VideoDecoder, 0x03 JPEG stripes →
+ * ImageDecoder/createImageBitmap, 0x04 striped H.264 → per-stripe
+ * VideoDecoder pool, 0x01 Opus → AudioDecoder → AudioWorklet), canvas
+ * compositor, CLIENT_FRAME_ACK backpressure, clipboard and stats plumbing.
+ * Fresh implementation against the byte-exact protocol documented in
+ * selkies_tpu/protocol/wire.py.
+ */
+
+"use strict";
+
+class SelkiesClient {
+  constructor(opts) {
+    this.canvas = opts.canvas;
+    this.ctx = this.canvas.getContext("2d");
+    this.url = opts.url ||
+      (location.protocol === "https:" ? "wss://" : "ws://") +
+      location.host + "/websockets";
+    this.displayId = opts.displayId || "primary";
+    this.onStatus = opts.onStatus || (() => {});
+    this.onStats = opts.onStats || (() => {});
+    this.onServerSettings = opts.onServerSettings || (() => {});
+    this.onClipboard = opts.onClipboard || (() => {});
+    this.onCursor = opts.onCursor || (() => {});
+
+    this.settings = Object.assign({
+      videoWidth: 1920, videoHeight: 1080, framerate: 60,
+      encoder: "jpeg", videoQuality: 60,
+    }, opts.settings || {});
+
+    this.ws = null;
+    this.connected = false;
+    this.lastFrameId = -1;
+    this.ackTimer = null;
+    this.statTimer = null;
+
+    // decoders
+    this.videoDecoder = null;          // full-frame H.264
+    this.stripeDecoders = new Map();   // y_start -> VideoDecoder
+    this.audioCtx = null;
+    this.audioDecoder = null;
+    this.audioQueueTime = 0;
+
+    // render fps accounting
+    this.framesRendered = 0;
+    this.lastFpsAt = performance.now();
+    this.renderFps = 0;
+    this.bytesReceived = 0;
+  }
+
+  /* ------------------------------------------------------ connection */
+
+  connect() {
+    this.onStatus("connecting");
+    const ws = new WebSocket(this.url);
+    ws.binaryType = "arraybuffer";
+    this.ws = ws;
+    ws.onopen = () => this._onOpen();
+    ws.onmessage = (ev) => this._onMessage(ev);
+    ws.onclose = () => this._onClose();
+    ws.onerror = () => this.onStatus("error");
+  }
+
+  disconnect() {
+    if (this.ackTimer) clearInterval(this.ackTimer);
+    if (this.statTimer) clearInterval(this.statTimer);
+    if (this.ws) this.ws.close();
+  }
+
+  _onOpen() {
+    this.onStatus("negotiating");
+    this.send("SETTINGS," + JSON.stringify(this.settings));
+    // client-ACK backpressure loop (reference selkies-core.js:2551-2560)
+    this.ackTimer = setInterval(() => {
+      if (this.lastFrameId >= 0 && this.connected) {
+        this.send("CLIENT_FRAME_ACK " + this.lastFrameId);
+      }
+    }, 50);
+    this.statTimer = setInterval(() => this._reportStats(), 1000);
+    this.connected = true;
+    this.onStatus("connected");
+  }
+
+  _onClose() {
+    this.connected = false;
+    this.onStatus("disconnected");
+    if (this.ackTimer) clearInterval(this.ackTimer);
+    if (this.statTimer) clearInterval(this.statTimer);
+    this._resetDecoders();
+  }
+
+  send(text) {
+    if (this.ws && this.ws.readyState === WebSocket.OPEN) this.ws.send(text);
+  }
+
+  sendBinary(buf) {
+    if (this.ws && this.ws.readyState === WebSocket.OPEN) this.ws.send(buf);
+  }
+
+  /* ----------------------------------------------------------- demux */
+
+  _onMessage(ev) {
+    if (typeof ev.data === "string") {
+      this._onText(ev.data);
+      return;
+    }
+    const data = new Uint8Array(ev.data);
+    if (!data.length) return;
+    this.bytesReceived += data.length;
+    switch (data[0]) {
+      case 0x00: this._onFullFrame(data); break;
+      case 0x01: this._onAudio(data); break;
+      case 0x03: this._onJpegStripe(data); break;
+      case 0x04: this._onH264Stripe(data); break;
+      default: break;
+    }
+  }
+
+  _onText(msg) {
+    if (msg.startsWith("MODE ")) return;
+    if (msg.startsWith("PIPELINE_RESETTING")) {
+      this.lastFrameId = -1;
+      this._resetDecoders();
+      return;
+    }
+    if (msg.startsWith("KILL")) {
+      this.onStatus("superseded");
+      this.disconnect();
+      return;
+    }
+    if (msg.startsWith("cursor,")) {
+      try { this.onCursor(JSON.parse(msg.slice(7))); } catch (e) {}
+      return;
+    }
+    if (msg.startsWith("clipboard,")) {
+      try { this.onClipboard(atob(msg.slice(10))); } catch (e) {}
+      return;
+    }
+    if (msg.startsWith("VIDEO_") || msg.startsWith("AUDIO_")) return;
+    if (msg.startsWith("{")) {
+      let body;
+      try { body = JSON.parse(msg); } catch (e) { return; }
+      if (body.type === "server_settings") {
+        this.onServerSettings(body.settings || body);
+      } else if (body.type === "stream_resolution") {
+        this._applyResolution(body);
+      } else if (body.type && body.type.endsWith("_stats")) {
+        this.onStats(body);
+      }
+    }
+  }
+
+  _applyResolution(body) {
+    const w = body.width || this.settings.videoWidth;
+    const h = body.height || this.settings.videoHeight;
+    if (this.canvas.width !== w || this.canvas.height !== h) {
+      this.canvas.width = w;
+      this.canvas.height = h;
+      this._resetDecoders();
+    }
+  }
+
+  _u16(data, off) { return (data[off] << 8) | data[off + 1]; }
+
+  /* ------------------------------------------------------ video: JPEG */
+
+  async _onJpegStripe(data) {
+    const frameId = this._u16(data, 2);
+    const yStart = this._u16(data, 4);
+    const blob = new Blob([data.subarray(6)], { type: "image/jpeg" });
+    try {
+      const bmp = await createImageBitmap(blob);
+      this.ctx.drawImage(bmp, 0, yStart);
+      bmp.close();
+      this._frameDelivered(frameId);
+    } catch (e) { /* damaged stripe: skip, next key stripe repairs */ }
+  }
+
+  /* ------------------------------------------------ video: full H.264 */
+
+  _makeVideoDecoder(onFrame) {
+    const dec = new VideoDecoder({
+      output: onFrame,
+      error: (e) => { console.warn("VideoDecoder error", e); },
+    });
+    dec.configure({
+      codec: "avc1.42e01f",
+      optimizeForLatency: true,
+    });
+    return dec;
+  }
+
+  _onFullFrame(data) {
+    const isKey = data[1] === 1;
+    const frameId = this._u16(data, 2);
+    if (!this.videoDecoder || this.videoDecoder.state === "closed") {
+      if (!isKey) return;   // wait for a keyframe to start
+      this.videoDecoder = this._makeVideoDecoder((frame) => {
+        this.ctx.drawImage(frame, 0, 0);
+        frame.close();
+      });
+    }
+    if (!isKey && this.videoDecoder.decodeQueueSize > 8) return;
+    try {
+      this.videoDecoder.decode(new EncodedVideoChunk({
+        type: isKey ? "key" : "delta",
+        timestamp: performance.now() * 1000,
+        data: data.subarray(4),
+      }));
+      this._frameDelivered(frameId);
+    } catch (e) { this._resetDecoders(); }
+  }
+
+  /* --------------------------------------------- video: striped H.264 */
+
+  _onH264Stripe(data) {
+    const isKey = data[1] === 1;
+    const frameId = this._u16(data, 2);
+    const yStart = this._u16(data, 4);
+    let entry = this.stripeDecoders.get(yStart);
+    if (!entry) {
+      if (!isKey) return;
+      const dec = this._makeVideoDecoder((frame) => {
+        this.ctx.drawImage(frame, 0, yStart);
+        frame.close();
+      });
+      entry = { dec };
+      this.stripeDecoders.set(yStart, entry);
+    }
+    try {
+      entry.dec.decode(new EncodedVideoChunk({
+        type: isKey ? "key" : "delta",
+        timestamp: performance.now() * 1000,
+        data: data.subarray(10),
+      }));
+      this._frameDelivered(frameId);
+    } catch (e) {
+      this.stripeDecoders.delete(yStart);
+    }
+  }
+
+  /* ----------------------------------------------------------- audio */
+
+  async _ensureAudio() {
+    if (this.audioCtx) return;
+    this.audioCtx = new AudioContext({ sampleRate: 48000 });
+    this.audioDecoder = new AudioDecoder({
+      output: (audioData) => this._playAudio(audioData),
+      error: (e) => console.warn("AudioDecoder error", e),
+    });
+    this.audioDecoder.configure({
+      codec: "opus", sampleRate: 48000, numberOfChannels: 2,
+    });
+  }
+
+  async _onAudio(data) {
+    try {
+      await this._ensureAudio();
+      this.audioDecoder.decode(new EncodedAudioChunk({
+        type: "key",
+        timestamp: performance.now() * 1000,
+        data: data.subarray(2),
+      }));
+    } catch (e) { /* audio is best-effort */ }
+  }
+
+  _playAudio(audioData) {
+    const ctx = this.audioCtx;
+    const buf = ctx.createBuffer(
+      audioData.numberOfChannels, audioData.numberOfFrames, 48000);
+    for (let ch = 0; ch < audioData.numberOfChannels; ch++) {
+      const arr = new Float32Array(audioData.numberOfFrames);
+      audioData.copyTo(arr, { planeIndex: ch, format: "f32-planar" });
+      buf.copyToChannel(arr, ch);
+    }
+    audioData.close();
+    const src = ctx.createBufferSource();
+    src.buffer = buf;
+    src.connect(ctx.destination);
+    const now = ctx.currentTime;
+    if (this.audioQueueTime < now + 0.02) this.audioQueueTime = now + 0.02;
+    src.start(this.audioQueueTime);
+    this.audioQueueTime += buf.duration;
+  }
+
+  /* -------------------------------------------------- mic (reverse) */
+
+  async startMicrophone() {
+    const stream = await navigator.mediaDevices.getUserMedia({ audio: true });
+    const ctx = new AudioContext({ sampleRate: 24000 });
+    const srcNode = ctx.createMediaStreamSource(stream);
+    const proc = ctx.createScriptProcessor(1024, 1, 1);
+    proc.onaudioprocess = (ev) => {
+      const f32 = ev.inputBuffer.getChannelData(0);
+      const out = new Int16Array(f32.length + 1);
+      const bytes = new Uint8Array(out.buffer);
+      bytes[0] = 0x02;                     // MIC_PCM
+      const s16 = new Int16Array(f32.length);
+      for (let i = 0; i < f32.length; i++) {
+        s16[i] = Math.max(-32768, Math.min(32767, f32[i] * 32768));
+      }
+      const framed = new Uint8Array(1 + s16.byteLength);
+      framed[0] = 0x02;
+      framed.set(new Uint8Array(s16.buffer), 1);
+      this.sendBinary(framed.buffer);
+    };
+    srcNode.connect(proc);
+    proc.connect(ctx.destination);
+    this._micCtx = ctx;
+  }
+
+  /* ------------------------------------------------------- clipboard */
+
+  sendClipboard(text) {
+    this.send("cw," + btoa(unescape(encodeURIComponent(text))));
+  }
+
+  requestClipboard() { this.send("cr"); }
+
+  /* ----------------------------------------------------- file upload */
+
+  async uploadFile(file) {
+    this.send(`FILE_UPLOAD_START:${file.name}:${file.size}`);
+    const chunk = 256 * 1024;
+    for (let off = 0; off < file.size; off += chunk) {
+      const slice = await file.slice(off, off + chunk).arrayBuffer();
+      const framed = new Uint8Array(1 + slice.byteLength);
+      framed[0] = 0x01;                    // FILE_CHUNK
+      framed.set(new Uint8Array(slice), 1);
+      this.sendBinary(framed.buffer);
+    }
+    this.send(`FILE_UPLOAD_END:${file.name}`);
+  }
+
+  /* --------------------------------------------------------- control */
+
+  requestResize(w, h) {
+    this.send(`r,${w}x${h},${this.displayId}`);
+  }
+
+  setVideoEnabled(on) { this.send(on ? "START_VIDEO" : "STOP_VIDEO"); }
+  setAudioEnabled(on) { this.send(on ? "START_AUDIO" : "STOP_AUDIO"); }
+
+  /* ----------------------------------------------------------- stats */
+
+  _frameDelivered(frameId) {
+    this.lastFrameId = frameId;
+    this.framesRendered++;
+  }
+
+  _reportStats() {
+    const now = performance.now();
+    const dt = (now - this.lastFpsAt) / 1000;
+    this.renderFps = this.framesRendered / Math.max(dt, 1e-3);
+    this.framesRendered = 0;
+    this.lastFpsAt = now;
+    this.send("_f " + Math.round(this.renderFps));
+    this.onStats({
+      type: "client_stats",
+      fps: this.renderFps,
+      kbps: Math.round(this.bytesReceived * 8 / 1000 / Math.max(dt, 1e-3)),
+    });
+    this.bytesReceived = 0;
+  }
+
+  _resetDecoders() {
+    if (this.videoDecoder && this.videoDecoder.state !== "closed") {
+      try { this.videoDecoder.close(); } catch (e) {}
+    }
+    this.videoDecoder = null;
+    for (const { dec } of this.stripeDecoders.values()) {
+      try { dec.close(); } catch (e) {}
+    }
+    this.stripeDecoders.clear();
+  }
+}
+
+if (typeof module !== "undefined") module.exports = { SelkiesClient };
