@@ -30,7 +30,8 @@ W, H = 1920, 1080
 WARMUP_FRAMES = 24
 BENCH_FRAMES = 300
 MAX_SECONDS = 90.0
-PIPELINE_DEPTH = 12  # deep enough to hide ~100 ms tunneled-D2H latency
+PIPELINE_DEPTH = 12   # deep enough to hide ~100 ms tunneled-D2H latency
+FETCH_GROUP = 4      # frames per D2H read (tunnel allows ~6 concurrent RPCs)
 
 
 def main() -> None:
@@ -42,7 +43,7 @@ def main() -> None:
 
     base = JpegStripeEncoder(W, H)
     src = DeviceScrollSource(W, H)
-    enc = PipelinedJpegEncoder(base, depth=PIPELINE_DEPTH)
+    enc = PipelinedJpegEncoder(base, depth=PIPELINE_DEPTH, fetch_group=FETCH_GROUP)
 
     def padded(frame):
         if frame.shape[0] == base.pad_h and frame.shape[1] == base.pad_w:

@@ -32,15 +32,28 @@ from .jpeg import META_WORDS_PER_STRIPE, JpegStripeEncoder, StripeOutput, split_
 
 
 @dataclass
+class _FetchGroup:
+    """One D2H read covering several frames' packed buffers, concatenated
+    on device: RPC-attached chips pay fixed per-transfer latency and allow
+    only a handful of concurrent reads, so frames-per-read — not bytes —
+    sets the fetch ceiling."""
+
+    arr: Any                        # device concat, one async host copy
+    stride: int                     # words per member (meta + guess)
+    host: Optional[np.ndarray] = None
+
+
+@dataclass
 class _InFlight:
     seq: int
     paint_candidate: np.ndarray
     packed: Any                     # full device buffer (meta head + words)
-    fetched: Any                    # in-flight slice copy (predicted size)
-    guess_words: int                # payload words included in `fetched`
     yq: Any
     cbq: Any
     crq: Any
+    group: Optional[_FetchGroup] = None
+    group_index: int = 0
+    guess_words: int = 0
     meta_done: bool = False
     emit: Optional[np.ndarray] = None
     is_paint: Optional[np.ndarray] = None
@@ -61,12 +74,15 @@ class PipelinedJpegEncoder:
         enc.flush()                       # drain everything (blocking)
     """
 
-    def __init__(self, base: JpegStripeEncoder, depth: int = 8) -> None:
+    def __init__(self, base: JpegStripeEncoder, depth: int = 8,
+                 fetch_group: int = 1) -> None:
         if base.entropy != "device":
             raise ValueError("pipelining requires entropy='device'")
         self.base = base
         self.depth = depth
+        self.fetch_group = max(1, fetch_group)
         self._inflight: deque[_InFlight] = deque()
+        self._unfetched: List[_InFlight] = []
         self._ready: List[Tuple[int, List[StripeOutput]]] = []
         self._seq = 0
         self._meta_words = META_WORDS_PER_STRIPE * base.n_stripes
@@ -114,18 +130,34 @@ class PipelinedJpegEncoder:
         packed, new_prev, yq, cbq, crq = b._step(
             frame, b._prev, b._qy, b._qc, qsel)
         b._prev = new_prev
-        guess = self._guess
-        fetched = packed[: self._meta_words + guess]
-        fetched.copy_to_host_async()
         item = _InFlight(
             seq=self._seq, paint_candidate=paint_candidate,
-            packed=packed, fetched=fetched, guess_words=guess,
-            yq=yq, cbq=cbq, crq=crq,
+            packed=packed, yq=yq, cbq=cbq, crq=crq,
         )
         self._seq += 1
         self._inflight.append(item)
+        self._unfetched.append(item)
+        if len(self._unfetched) >= self.fetch_group:
+            self._issue_fetch()
         self._advance_ready()
         return item.seq
+
+    def _issue_fetch(self) -> None:
+        """Combine the pending frames' buffers into ONE device concat and
+        start a single async host copy for the lot."""
+        group_items, self._unfetched = self._unfetched, []
+        if not group_items:
+            return
+        guess = self._guess
+        stride = self._meta_words + guess
+        slices = [it.packed[:stride] for it in group_items]
+        arr = slices[0] if len(slices) == 1 else jnp.concatenate(slices)
+        arr.copy_to_host_async()
+        group = _FetchGroup(arr=arr, stride=stride)
+        for i, it in enumerate(group_items):
+            it.group = group
+            it.group_index = i
+            it.guess_words = guess
 
     # -- pipeline stages ---------------------------------------------------
 
@@ -147,9 +179,17 @@ class PipelinedJpegEncoder:
         """Move one item forward; returns True when fully harvestable."""
         b = self.base
         if not item.meta_done:
-            if not block and not item.fetched.is_ready():
+            if item.group is None:
+                if not block:
+                    return False
+                self._issue_fetch()   # flush the partial group
+            if not block and not item.group.arr.is_ready():
                 return False
-            buf = np.asarray(item.fetched)
+            if item.group.host is None:
+                item.group.host = np.asarray(item.group.arr)
+            stride = item.group.stride
+            buf = item.group.host[item.group_index * stride:
+                                  (item.group_index + 1) * stride]
             nbytes_np, base_np, ovf_np, damage_np = split_meta(
                 buf[: self._meta_words], b.n_stripes)
             emit, is_paint = b._decide_emits(
@@ -197,6 +237,10 @@ class PipelinedJpegEncoder:
     def poll(self) -> List[Tuple[int, List[StripeOutput]]]:
         """Harvest all completed frames (non-blocking, in order)."""
         out, self._ready = self._ready, []
+        # a partial fetch group must not strand frames when submissions
+        # pause: polling is the deadline that flushes it
+        if self._unfetched:
+            self._issue_fetch()
         self._advance_ready()
         while self._inflight and self._advance(self._inflight[0], block=False):
             item = self._inflight.popleft()

@@ -170,3 +170,25 @@ def test_pipelined_paintover_not_duplicated():
     outs.extend(s for _, st in pipe.flush() for s in st)
     paint = [s for s in outs if s.is_paintover]
     assert len(paint) == 1
+
+
+def test_pipeline_partial_group_flushed_by_poll():
+    """fetch_group > 1 must not strand frames when submissions pause
+    (regression: poll() flushes a partial fetch group)."""
+    import numpy as np
+
+    from selkies_tpu.encoder.jpeg import JpegStripeEncoder
+    from selkies_tpu.encoder.pipeline import PipelinedJpegEncoder
+
+    enc = PipelinedJpegEncoder(
+        JpegStripeEncoder(64, 64, stripe_height=64), depth=8, fetch_group=4)
+    rng = np.random.default_rng(0)
+    for i in range(2):   # fewer than fetch_group
+        enc.submit(rng.integers(0, 255, (64, 64, 3), dtype=np.uint8))
+    got = []
+    for _ in range(50):
+        got += enc.poll()
+        if len(got) == 2:
+            break
+    assert len(got) == 2
+    assert all(stripes for _, stripes in got)

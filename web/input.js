@@ -132,9 +132,10 @@ class SelkiesInput {
 
   _gamepadConnected(ev) {
     const gp = ev.gamepad;
+    // wire order is axes,buttons (server handler.py gamepad connect)
     this.client.send(
       `js,c,${gp.index},${btoa(gp.id).slice(0, 32)},` +
-      `${gp.buttons.length},${gp.axes.length}`);
+      `${gp.axes.length},${gp.buttons.length}`);
     this.gamepadState.set(gp.index, {
       buttons: gp.buttons.map((b) => b.value),
       axes: gp.axes.slice(),

@@ -41,7 +41,9 @@ class SelkiesClient {
 
     // decoders
     this.videoDecoder = null;          // full-frame H.264
+    this._needKey = false;             // delta dropped: wait for key
     this.stripeDecoders = new Map();   // y_start -> VideoDecoder
+    this.stripeSeq = new Map();        // y_start -> last frame_id painted
     this.audioCtx = null;
     this.audioDecoder = null;
     this.audioQueueTime = 0;
@@ -138,7 +140,10 @@ class SelkiesClient {
       return;
     }
     if (msg.startsWith("clipboard,")) {
-      try { this.onClipboard(atob(msg.slice(10))); } catch (e) {}
+      // inverse of sendClipboard: base64 of UTF-8 bytes
+      try {
+        this.onClipboard(decodeURIComponent(escape(atob(msg.slice(10)))));
+      } catch (e) {}
       return;
     }
     if (msg.startsWith("VIDEO_") || msg.startsWith("AUDIO_")) return;
@@ -175,6 +180,14 @@ class SelkiesClient {
     const blob = new Blob([data.subarray(6)], { type: "image/jpeg" });
     try {
       const bmp = await createImageBitmap(blob);
+      // async decode can complete out of order: never paint a stripe
+      // older (mod 2^16) than what's already on screen at this y
+      const prev = this.stripeSeq.get(yStart);
+      if (prev !== undefined && ((frameId - prev) & 0xffff) > 0x8000) {
+        bmp.close();
+        return;
+      }
+      this.stripeSeq.set(yStart, frameId);
       this.ctx.drawImage(bmp, 0, yStart);
       bmp.close();
       this._frameDelivered(frameId);
@@ -205,7 +218,14 @@ class SelkiesClient {
         frame.close();
       });
     }
-    if (!isKey && this.videoDecoder.decodeQueueSize > 8) return;
+    // after any skipped delta the reference chain is broken: discard
+    // further deltas until the next keyframe repairs it
+    if (!isKey && this._needKey) return;
+    if (!isKey && this.videoDecoder.decodeQueueSize > 8) {
+      this._needKey = true;
+      return;
+    }
+    if (isKey) this._needKey = false;
     try {
       this.videoDecoder.decode(new EncodedVideoChunk({
         type: isKey ? "key" : "delta",
@@ -292,20 +312,18 @@ class SelkiesClient {
 
   async startMicrophone() {
     const stream = await navigator.mediaDevices.getUserMedia({ audio: true });
-    const ctx = new AudioContext({ sampleRate: 24000 });
+    // server MicSink plays at the capture settings rate (48 kHz default)
+    const ctx = new AudioContext({ sampleRate: 48000 });
     const srcNode = ctx.createMediaStreamSource(stream);
     const proc = ctx.createScriptProcessor(1024, 1, 1);
     proc.onaudioprocess = (ev) => {
       const f32 = ev.inputBuffer.getChannelData(0);
-      const out = new Int16Array(f32.length + 1);
-      const bytes = new Uint8Array(out.buffer);
-      bytes[0] = 0x02;                     // MIC_PCM
       const s16 = new Int16Array(f32.length);
       for (let i = 0; i < f32.length; i++) {
         s16[i] = Math.max(-32768, Math.min(32767, f32[i] * 32768));
       }
       const framed = new Uint8Array(1 + s16.byteLength);
-      framed[0] = 0x02;
+      framed[0] = 0x02;                    // MIC_PCM
       framed.set(new Uint8Array(s16.buffer), 1);
       this.sendBinary(framed.buffer);
     };
@@ -327,7 +345,16 @@ class SelkiesClient {
   async uploadFile(file) {
     this.send(`FILE_UPLOAD_START:${file.name}:${file.size}`);
     const chunk = 256 * 1024;
+    const highWater = 4 * 1024 * 1024;
     for (let off = 0; off < file.size; off += chunk) {
+      // backpressure: don't balloon the socket buffer past the drain rate
+      while (this.ws && this.ws.bufferedAmount > highWater) {
+        await new Promise((r) => setTimeout(r, 20));
+      }
+      if (!this.ws || this.ws.readyState !== WebSocket.OPEN) {
+        this.send(`FILE_UPLOAD_ERROR:${file.name}:connection lost`);
+        return;
+      }
       const slice = await file.slice(off, off + chunk).arrayBuffer();
       const framed = new Uint8Array(1 + slice.byteLength);
       framed[0] = 0x01;                    // FILE_CHUNK
@@ -349,7 +376,12 @@ class SelkiesClient {
   /* ----------------------------------------------------------- stats */
 
   _frameDelivered(frameId) {
-    this.lastFrameId = frameId;
+    // only advance the ACK id forward (mod 2^16): a late stripe must not
+    // regress it and inflate the server's backpressure estimate
+    if (this.lastFrameId < 0 ||
+        ((frameId - this.lastFrameId) & 0xffff) < 0x8000) {
+      this.lastFrameId = frameId;
+    }
     this.framesRendered++;
   }
 
