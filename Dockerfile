@@ -1,0 +1,32 @@
+# Wheel-build + runtime image for selkies-tpu (parity: reference root
+# Dockerfile, the py-build container in SURVEY.md §2.6).
+#
+# The runtime stage expects a JAX with TPU support baked into the base
+# image (libtpu containers) — the framework itself is pure Python + two
+# small C shims built here.
+
+FROM python:3.12-slim AS build
+WORKDIR /src
+COPY pyproject.toml ./
+COPY selkies_tpu ./selkies_tpu
+COPY web ./web
+RUN pip install --no-cache-dir build && python -m build --wheel --outdir /dist
+
+FROM python:3.12-slim AS shims
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        gcc make libc6-dev && rm -rf /var/lib/apt/lists/*
+COPY native /src/native
+RUN make -C /src/native/interposer && make -C /src/native/fake-udev
+
+FROM python:3.12-slim
+LABEL org.opencontainers.image.title="selkies-tpu"
+COPY --from=build /dist/*.whl /tmp/
+COPY --from=shims /src/native/interposer/selkies_joystick_interposer.so \
+        /usr/lib/selkies/selkies_joystick_interposer.so
+COPY --from=shims /src/native/fake-udev/libudev.so.1.0.0-fake \
+        /usr/lib/selkies/libudev.so.1.0.0-fake
+COPY web /opt/selkies-tpu/web
+RUN pip install --no-cache-dir /tmp/*.whl websockets aiohttp numpy \
+        prometheus-client && rm /tmp/*.whl
+EXPOSE 8080 8082 8000
+ENTRYPOINT ["selkies-tpu"]

@@ -1,0 +1,20 @@
+#!/bin/bash
+# turnserver in REST-credential mode: usernames minted by turn-rest /
+# the signaling /turn endpoint validate against the same shared secret.
+set -e
+
+EXTERNAL_IP="${EXTERNAL_IP:-$(curl -fs https://checkip.amazonaws.com 2>/dev/null || hostname -I | awk '{print $1}')}"
+
+exec turnserver -n \
+    --listening-port="${TURN_PORT:-3478}" \
+    --tls-listening-port="${TURN_TLS_PORT:-5349}" \
+    --realm="${TURN_REALM:-selkies.local}" \
+    --use-auth-secret \
+    --static-auth-secret="${TURN_SHARED_SECRET:?TURN_SHARED_SECRET required}" \
+    --external-ip="${EXTERNAL_IP}" \
+    --min-port="${TURN_MIN_PORT:-49152}" \
+    --max-port="${TURN_MAX_PORT:-65535}" \
+    --prometheus \
+    --no-cli \
+    --fingerprint \
+    --verbose

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Boots the virtual display with the extensions the capture/input planes
+# need (MIT-SHM for XShm capture, XTEST for injection, RANDR for layout,
+# DAMAGE for change detection — parity: reference example entrypoint).
+set -e
+
+export DISPLAY="${DISPLAY:-:20}"
+SCREEN="${XVFB_SCREEN:-8192x4096x24}"
+
+Xvfb "$DISPLAY" -screen 0 "$SCREEN" \
+     +extension MIT-SHM +extension XTEST +extension RANDR \
+     +extension DAMAGE +extension XFIXES -nolisten tcp -noreset &
+
+for i in $(seq 1 50); do
+    xdpyinfo -display "$DISPLAY" >/dev/null 2>&1 && break
+    sleep 0.2
+done
+
+# gamepad shims for applications launched inside this session
+export SELKIES_INTERPOSER_SOCKET_DIR=/tmp
+if [ -f /usr/lib/selkies/selkies_joystick_interposer.so ]; then
+    export LD_PRELOAD="/usr/lib/selkies/selkies_joystick_interposer.so${LD_PRELOAD:+:$LD_PRELOAD}"
+fi
+
+exec supervisord -n -c /etc/supervisor/supervisord.conf
