@@ -209,9 +209,10 @@ class H264StripeEncoder:
     """Striped (or full-frame) H.264 encoder with damage gating.
 
     ``fullframe=True`` reproduces the reference's ``x264enc`` mode: one
-    stripe covering the whole frame, shipped as 0x04 frames of full height
-    (the reference does the same — fullframe is striped mode with one
-    stripe, selkies.py:2937 h264_fullframe).
+    stripe covering the whole frame. The server ships it as 0x00
+    full-frame packets (the wire routing lives in the encoder adapter's
+    ``wire_fullframe`` flag, not here — reference h264_fullframe,
+    selkies.py:2937, wire demux selkies-core.js 0x00 path).
     """
 
     def __init__(self, width: int, height: int, *, stripe_height: int = 64,

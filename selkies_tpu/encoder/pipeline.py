@@ -253,3 +253,68 @@ class PipelinedJpegEncoder:
         while self._inflight:
             out.append(self._drain_one())
         return out
+
+
+class ThreadedEncoderAdapter:
+    """submit()/poll()/flush() facade over a synchronous ``encode_frame``
+    encoder (the H.264 profiles), keeping the shared event loop free: one
+    worker thread preserves frame order, a bounded queue drops frames
+    under overload exactly like try_submit does."""
+
+    def __init__(self, base, depth: int = 3,
+                 wire_fullframe: bool = False) -> None:
+        import concurrent.futures
+
+        self.base = base
+        self.depth = depth
+        #: ship as one 0x00 full-frame packet instead of 0x04 stripes
+        self.wire_fullframe = wire_fullframe
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpuenc")
+        self._pending: deque = deque()
+        self._done: List = []
+        self._seq = 0
+
+    def try_submit(self, frame) -> Optional[int]:
+        self._harvest()
+        if len(self._pending) >= self.depth:
+            return None
+        return self.submit(frame)
+
+    def _harvest(self) -> None:
+        while self._pending and self._pending[0][1].done():
+            seq, fut = self._pending.popleft()
+            try:
+                self._done.append((seq, fut.result()))
+            except Exception:  # encoder error: drop the frame, keep going
+                import logging
+
+                logging.getLogger(__name__).exception("encode failed")
+
+    def submit(self, frame) -> int:
+        seq = self._seq
+        self._seq += 1
+        self._pending.append(
+            (seq, self._pool.submit(self.base.encode_frame, frame)))
+        return seq
+
+    def poll(self):
+        self._harvest()
+        out, self._done = self._done, []
+        return out
+
+    def flush(self):
+        out, self._done = self._done, []
+        while self._pending:
+            seq, fut = self._pending.popleft()
+            try:
+                out.append((seq, fut.result()))
+            except Exception:
+                pass
+        return out
+
+    def close(self) -> None:
+        """Stop the worker and abandon queued frames (display teardown)."""
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        self._pending.clear()
+        self._done.clear()

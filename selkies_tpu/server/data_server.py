@@ -25,6 +25,8 @@ from typing import Any, Callable, Dict, Optional, Set
 
 from ..protocol.wire import (
     FrameId,
+    pack_full_frame,
+    pack_h264_stripe,
     pack_jpeg_stripe,
     parse_text_message,
 )
@@ -41,10 +43,29 @@ def default_encoder_factory(
     width: int, height: int, settings: Settings,
     overrides: Optional[Dict[str, Any]] = None,
 ):
+    """Encoder-profile selection (parity: the reference's encoder enum,
+    settings.py 'encoder' / pixelflux output_mode): ``jpeg`` is the
+    device-entropy striped pipeline; ``x264enc-striped``/``x264enc`` are
+    the TPU H.264 profiles (striped / one full-frame stripe). CRF settings
+    map onto the QP scale (both 0-51)."""
     from ..encoder.jpeg import JpegStripeEncoder
-    from ..encoder.pipeline import PipelinedJpegEncoder
+    from ..encoder.pipeline import PipelinedJpegEncoder, ThreadedEncoderAdapter
 
     ov = overrides or {}
+    profile = ov.get("encoder", settings.encoder)
+    if profile in ("x264enc", "x264enc-striped"):
+        from ..encoder.h264 import H264StripeEncoder
+
+        crf = int(ov.get("h264_crf", settings.h264_crf.default))
+        paint_crf = int(ov.get("h264_paintover_crf",
+                               settings.h264_paintover_crf.default))
+        even_w, even_h = width - width % 2, height - height % 2
+        return ThreadedEncoderAdapter(H264StripeEncoder(
+            even_w, even_h,
+            stripe_height=int(settings.tpu_stripe_height),
+            qp=crf, paint_over_qp=paint_crf,
+            fullframe=(profile == "x264enc"),
+        ), depth=3, wire_fullframe=(profile == "x264enc"))
     return PipelinedJpegEncoder(
         JpegStripeEncoder(
             width,
@@ -553,7 +574,7 @@ class DataStreamingServer:
                     frame_id = FrameId.next(frame_id)
                     viewers = self._viewers_of(st.display_id)
                     for s in stripes:
-                        chunk = pack_jpeg_stripe(frame_id, s.y_start, s.jpeg)
+                        chunk = self._pack_stripe(frame_id, s, encoder)
                         if viewers:
                             websockets.broadcast(viewers, chunk)
                             self.bytes_sent += len(chunk) * len(viewers)
@@ -570,6 +591,23 @@ class DataStreamingServer:
             logger.exception("capture loop for %s crashed", st.display_id)
         finally:
             source.stop()
+            close = getattr(encoder, "close", None)
+            if close is not None:
+                close()
+
+    @staticmethod
+    def _pack_stripe(frame_id: int, s, encoder) -> bytes:
+        """Wire-pack one encoded stripe by profile: JPEG stripes → 0x03,
+        striped H.264 → 0x04, full-frame H.264 → 0x00 (the client's three
+        decode paths). The fullframe routing is an explicit encoder flag
+        set at construction — a short display can legitimately have one
+        stripe in striped mode and must still ship 0x04."""
+        if hasattr(s, "annexb"):
+            if getattr(encoder, "wire_fullframe", False):
+                return pack_full_frame(frame_id, s.annexb, s.is_key)
+            return pack_h264_stripe(
+                frame_id, s.y_start, s.width, s.height, s.annexb, s.is_key)
+        return pack_jpeg_stripe(frame_id, s.y_start, s.jpeg)
 
     async def _backpressure_loop(self, st: DisplayState) -> None:
         while True:

@@ -468,3 +468,43 @@ async def test_layout_dedup_skips_repeat_xrandr(tmp_path, monkeypatch):
         srv.close()
         await srv.wait_closed()
         await server.stop()
+
+
+@pytest.mark.anyio
+async def test_h264_encoder_selection(tmp_path):
+    """Client requesting x264enc-striped gets 0x04 frames; x264enc (full
+    frame) gets 0x00 — through the real TPU-profile H.264 encoder on CPU."""
+    env = {"SELKIES_PORT": "0"}
+    settings = Settings(argv=[], env=env)
+    app = StreamingApp(settings)
+    server = DataStreamingServer(
+        settings, app=app,
+        source_factory=lambda w, h, fps, **kw: FakeSource(w, h, fps),
+        host="127.0.0.1",
+    )
+    app.data_server = server
+    srv, port = await start_on_free_port(server)
+    try:
+        for encoder, expect_type in (("x264enc-striped", 0x04),
+                                     ("x264enc", 0x00)):
+            async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+                await handshake(ws)
+                await ws.send("SETTINGS," + json.dumps({
+                    "initialClientWidth": 64, "initialClientHeight": 64,
+                    "encoder": encoder, "framerate": 20}))
+                got = None
+                for _ in range(300):
+                    msg = await asyncio.wait_for(ws.recv(), 10)
+                    if isinstance(msg, bytes) and msg and \
+                            msg[0] == expect_type:
+                        got = msg
+                        break
+                assert got is not None, f"no 0x{expect_type:02x} frames"
+                if expect_type == 0x04:
+                    from selkies_tpu.protocol import unpack_binary
+                    f = unpack_binary(got)
+                    assert f.payload.startswith(b"\x00\x00\x00\x01")
+                    assert f.width and f.height
+    finally:
+        srv.close()
+        await server.stop()
