@@ -1,0 +1,93 @@
+"""Multi-session encode benchmark (BASELINE config 5, single-chip slice).
+
+Measures aggregate 1080p encode throughput with N independent desktop
+sessions time-sharing ONE chip — the realistic single-chip serving mode:
+each session runs its own pipelined encoder (own damage state, own
+bitstreams) and the round-robin scheduler keeps the device queue full.
+Cross-chip scaling of the same step (sessions data-parallel, stripes
+spatially sharded, psum rate feedback) lives in selkies_tpu.parallel and
+is validated by __graft_entry__.dryrun_multichip on a virtual mesh; real
+aggregate numbers on a v5e-8 slice are expected to scale with chips since
+sessions are embarrassingly parallel across the "session" axis.
+
+Prints ONE JSON line:
+  {"metric": "tpuenc_jpeg_multisession_aggregate_fps", ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N_SESSIONS = 4
+W, H = 1920, 1080
+WARMUP_FRAMES = 24
+BENCH_FRAMES = 400           # across all sessions
+MAX_SECONDS = 90.0
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from selkies_tpu.capture.synthetic import DeviceScrollSource
+    from selkies_tpu.encoder.jpeg import JpegStripeEncoder
+    from selkies_tpu.encoder.pipeline import PipelinedJpegEncoder
+
+    sessions = []
+    for i in range(N_SESSIONS):
+        base = JpegStripeEncoder(W, H)
+        sessions.append((
+            PipelinedJpegEncoder(base, depth=4, fetch_group=4),
+            DeviceScrollSource(W, H, seed=i),
+            base,
+        ))
+
+    def padded(base, frame):
+        if frame.shape[0] == base.pad_h:
+            return frame
+        return jnp.pad(
+            frame, ((0, base.pad_h - frame.shape[0]),
+                    (0, base.pad_w - frame.shape[1]), (0, 0)), mode="edge")
+
+    for i in range(WARMUP_FRAMES):
+        enc, src, base = sessions[i % N_SESSIONS]
+        enc.submit(padded(base, src.next_frame()))
+        enc.poll()
+    for enc, _, _ in sessions:
+        enc.flush()
+
+    done = 0
+    total_bytes = 0
+    submitted = 0
+    start = time.perf_counter()
+    while submitted < BENCH_FRAMES and \
+            time.perf_counter() - start < MAX_SECONDS:
+        enc, src, base = sessions[submitted % N_SESSIONS]
+        enc.submit(padded(base, src.next_frame()))
+        submitted += 1
+        for _seq, stripes in enc.poll():
+            done += 1
+            total_bytes += sum(len(s.jpeg) for s in stripes)
+    for enc, _, _ in sessions:
+        for _seq, stripes in enc.flush():
+            done += 1
+            total_bytes += sum(len(s.jpeg) for s in stripes)
+    elapsed = time.perf_counter() - start
+
+    fps = done / elapsed if elapsed > 0 else 0.0
+    print(json.dumps({
+        "metric": "tpuenc_jpeg_multisession_aggregate_fps",
+        "value": round(fps, 2),
+        "unit": "fps",
+        "sessions": N_SESSIONS,
+        "per_session_fps": round(fps / N_SESSIONS, 2),
+        "vs_baseline": round(fps / (60.0 * N_SESSIONS), 3),
+        "frames": done,
+        "elapsed_s": round(elapsed, 2),
+        "mean_frame_kb": round(total_bytes / max(done, 1) / 1024, 1),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,10 @@ class PipelinedJpegEncoder:
     def n_inflight(self) -> int:
         return len(self._inflight)
 
+    def force_keyframe(self) -> None:
+        """Next frame emits every stripe (viewer join / PIPELINE reset)."""
+        self.base.force_keyframe()
+
     def try_submit(self, frame) -> Optional[int]:
         """Dispatch one frame without ever blocking; returns None (frame
         dropped) when the pipeline is full. This is the capture-loop entry
@@ -292,11 +296,35 @@ class ThreadedEncoderAdapter:
                 logging.getLogger(__name__).exception("encode failed")
 
     def submit(self, frame) -> int:
+        # defensive crop: encoder dims can be tighter than the source's
+        # (H.264 needs even dims); mismatch must not poison the worker
+        h = getattr(self.base, "height", None)
+        w = getattr(self.base, "width", None)
+        if h is not None and frame.shape[0] >= h and frame.shape[1] >= w \
+                and (frame.shape[0] != h or frame.shape[1] != w):
+            frame = frame[:h, :w]
         seq = self._seq
         self._seq += 1
         self._pending.append(
             (seq, self._pool.submit(self.base.encode_frame, frame)))
         return seq
+
+    # control surface passthrough (PLI/viewer-join keyframes, rate control)
+    def request_keyframe(self) -> None:
+        rk = getattr(self.base, "request_keyframe", None)
+        if rk is not None:
+            rk()
+
+    force_keyframe = request_keyframe
+
+    @property
+    def qp(self):
+        return getattr(self.base, "qp", None)
+
+    @qp.setter
+    def qp(self, value):
+        if hasattr(self.base, "qp"):
+            self.base.qp = value
 
     def poll(self):
         self._harvest()

@@ -112,6 +112,8 @@ class DisplayState:
     video_active: bool = True
     #: clamped per-client setting overrides from the SETTINGS handshake
     overrides: Dict[str, Any] = field(default_factory=dict)
+    #: live encoder of the running capture loop (keyframe kicks)
+    encoder: Any = None
 
 
 @dataclass
@@ -212,6 +214,15 @@ class DataStreamingServer:
         self.clients.add(websocket)
         if self.metrics is not None:
             self.metrics.set_clients(len(self.clients))
+        # late-joining viewer (sharing modes): damage gating means static
+        # content would never reach it — force the next frame to be a full
+        # refresh / IDR on the primary stream
+        primary = self.display_clients.get("primary")
+        if primary is not None and primary.encoder is not None:
+            kick = getattr(primary.encoder, "force_keyframe", None) \
+                or getattr(primary.encoder, "request_keyframe", None)
+            if kick is not None:
+                kick()
         try:
             if (self.audio_pipeline is not None and self._audio_wanted
                     and not self.audio_pipeline.running):
@@ -549,6 +560,7 @@ class DataStreamingServer:
                 st.width, st.height, self.settings, st.overrides)
         except TypeError:  # factory without overrides support (tests, custom)
             encoder = self.encoder_factory(st.width, st.height, self.settings)
+        st.encoder = encoder
         try:
             source = self.source_factory(st.width, st.height, fps,
                                          x=st.x, y=st.y)
@@ -591,6 +603,7 @@ class DataStreamingServer:
             logger.exception("capture loop for %s crashed", st.display_id)
         finally:
             source.stop()
+            st.encoder = None
             close = getattr(encoder, "close", None)
             if close is not None:
                 close()

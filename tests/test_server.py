@@ -508,3 +508,30 @@ async def test_h264_encoder_selection(tmp_path):
     finally:
         srv.close()
         await server.stop()
+
+
+@pytest.mark.anyio
+async def test_viewer_join_forces_keyframe(tmp_path):
+    """A second (sharing) client connecting must kick a full refresh on the
+    primary stream — damage gating would otherwise leave it black."""
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    kicked = []
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as host_ws:
+            await handshake(host_ws)
+            await host_ws.send("SETTINGS," + json.dumps({"framerate": 30}))
+            for _ in range(100):
+                if encoders:
+                    break
+                await asyncio.sleep(0.02)
+            assert encoders
+            encoders[0].force_keyframe = lambda: kicked.append(True)
+            async with websockets.connect(
+                    f"ws://127.0.0.1:{port}") as viewer_ws:
+                await handshake(viewer_ws)   # viewer never sends SETTINGS
+                await asyncio.sleep(0.1)
+            assert kicked, "viewer join did not force a keyframe"
+    finally:
+        srv.close()
+        await server.stop()

@@ -48,14 +48,25 @@ class SelkiesInput {
     this.pointerLocked = false;
     this.gamepadTimer = null;
     this.gamepadState = new Map();   // index -> {buttons:[], axes:[]}
+    this.gamepadIndexOffset = 0;     // player2-4 sharing: remap pad slot
     this._handlers = [];
   }
 
+  _on(target, type, fn, opts) {
+    target.addEventListener(type, fn, opts);
+    this._handlers.push([target, type, fn, opts]);
+  }
+
+  /* Sharing modes: a #player2-4 client contributes only its gamepad
+     (reference sharing links, selkies-core.js hash modes). */
+  attachGamepadOnly() {
+    this._on(window, "gamepadconnected", (e) => this._gamepadConnected(e));
+    this._on(window, "gamepaddisconnected",
+             (e) => this._gamepadDisconnected(e));
+  }
+
   attach() {
-    const on = (target, type, fn, opts) => {
-      target.addEventListener(type, fn, opts);
-      this._handlers.push([target, type, fn, opts]);
-    };
+    const on = (target, type, fn, opts) => this._on(target, type, fn, opts);
     on(window, "keydown", (e) => this._key(e, true));
     on(window, "keyup", (e) => this._key(e, false));
     on(window, "blur", () => this.client.send("kr"));
@@ -130,11 +141,23 @@ class SelkiesInput {
 
   /* --------------------------------------------------------- gamepad */
 
+  /* A player2-4 sharing client owns exactly ONE fixed server slot
+     (its offset); the host keeps local indices. Anything else collides
+     when two clients both have a pad at local index 0. */
+  _slotOf(localIndex) {
+    if (this.gamepadIndexOffset) {
+      return localIndex === 0 ? this.gamepadIndexOffset : null;
+    }
+    return localIndex;
+  }
+
   _gamepadConnected(ev) {
     const gp = ev.gamepad;
+    const slot = this._slotOf(gp.index);
+    if (slot === null) return;
     // wire order is axes,buttons (server handler.py gamepad connect)
     this.client.send(
-      `js,c,${gp.index},${btoa(gp.id).slice(0, 32)},` +
+      `js,c,${slot},${btoa(gp.id).slice(0, 32)},` +
       `${gp.axes.length},${gp.buttons.length}`);
     this.gamepadState.set(gp.index, {
       buttons: gp.buttons.map((b) => b.value),
@@ -146,7 +169,8 @@ class SelkiesInput {
   }
 
   _gamepadDisconnected(ev) {
-    this.client.send(`js,d,${ev.gamepad.index}`);
+    const slot = this._slotOf(ev.gamepad.index);
+    if (slot !== null) this.client.send(`js,d,${slot}`);
     this.gamepadState.delete(ev.gamepad.index);
     if (!this.gamepadState.size && this.gamepadTimer) {
       clearInterval(this.gamepadTimer);
@@ -158,17 +182,18 @@ class SelkiesInput {
     for (const gp of navigator.getGamepads()) {
       if (!gp) continue;
       const prev = this.gamepadState.get(gp.index);
-      if (!prev) continue;
+      const slot = this._slotOf(gp.index);
+      if (!prev || slot === null) continue;
       gp.buttons.forEach((b, i) => {
         if (b.value !== prev.buttons[i]) {
           prev.buttons[i] = b.value;
-          this.client.send(`js,b,${gp.index},${i},${b.value.toFixed(3)}`);
+          this.client.send(`js,b,${slot},${i},${b.value.toFixed(3)}`);
         }
       });
       gp.axes.forEach((v, i) => {
         if (Math.abs(v - prev.axes[i]) > 0.01) {
           prev.axes[i] = v;
-          this.client.send(`js,a,${gp.index},${i},${v.toFixed(3)}`);
+          this.client.send(`js,a,${slot},${i},${v.toFixed(3)}`);
         }
       });
     }

@@ -32,6 +32,9 @@ class SelkiesClient {
       videoWidth: 1920, videoHeight: 1080, framerate: 60,
       encoder: "jpeg", videoQuality: 60,
     }, opts.settings || {});
+    // Sharing viewers receive the primary broadcast without negotiating:
+    // sending SETTINGS would take over (and kill) the host's session.
+    this.claimDisplay = opts.claimDisplay !== false;
 
     this.ws = null;
     this.connected = false;
@@ -76,7 +79,9 @@ class SelkiesClient {
 
   _onOpen() {
     this.onStatus("negotiating");
-    this.send("SETTINGS," + JSON.stringify(this.settings));
+    if (this.claimDisplay) {
+      this.send("SETTINGS," + JSON.stringify(this.settings));
+    }
     // client-ACK backpressure loop (reference selkies-core.js:2551-2560)
     this.ackTimer = setInterval(() => {
       if (this.lastFrameId >= 0 && this.connected) {
