@@ -43,7 +43,8 @@ class StripeOutput:
     is_paintover: bool
 
 
-def _encode_body(frame, prev, qy, qc, qsel, *, stripe_h: int):
+def _encode_body(frame, prev, qy, qc, qsel, *, stripe_h: int,
+                 wm_scaled=None, alpha_inv=None):
     """One whole-frame encode dispatch.
 
     Args:
@@ -51,6 +52,9 @@ def _encode_body(frame, prev, qy, qc, qsel, *, stripe_h: int):
       prev:  [H, W, 3] uint8 previous frame (for damage detection); donated.
       qy/qc: [nq, 8, 8] float32 quant tables (normal, paint-over, ...).
       qsel:  [S] int32 per-stripe table index.
+      wm_scaled/alpha_inv: optional watermark overlay (premultiplied RGB
+        [H, W, 3] u16 and inverse alpha [H, W, 1] u16) blended on device —
+        the pixelflux watermark feature (reference selkies.py:2959-2962).
     Returns:
       yq  [H/8,  W/8,  64] int16 zigzag coefficients,
       cbq [H/16, W/16, 64] int16,
@@ -60,6 +64,11 @@ def _encode_body(frame, prev, qy, qc, qsel, *, stripe_h: int):
     """
     h, w, _ = frame.shape
     s = h // stripe_h
+
+    if wm_scaled is not None:
+        blended = (frame.astype(jnp.uint32) * alpha_inv.astype(jnp.uint32)
+                   + wm_scaled.astype(jnp.uint32) + 127) // 255
+        frame = blended.astype(jnp.uint8)
 
     diff = jnp.abs(frame.astype(jnp.int16) - prev.astype(jnp.int16))
     damage = diff.reshape(s, stripe_h * w * 3).max(axis=1).astype(jnp.int32)
@@ -94,7 +103,8 @@ _device_encode = functools.partial(
 
 
 @functools.lru_cache(maxsize=32)
-def _device_pipeline(pad_h: int, pad_w: int, stripe_h: int):
+def _device_pipeline(pad_h: int, pad_w: int, stripe_h: int,
+                     watermark: bool = False):
     """Shared (packer, jitted step) per frame geometry.
 
     Keyed like :func:`device_entropy.scan_geometry` so reconnects/resizes to
@@ -111,9 +121,11 @@ def _device_pipeline(pad_h: int, pad_w: int, stripe_h: int):
     n_stripes = pad_h // stripe_h
 
     @functools.partial(jax.jit, donate_argnames=("prev",))
-    def step(frame, prev, qy, qc, qsel):
+    def step(frame, prev, qy, qc, qsel, wm_scaled=None, alpha_inv=None):
         yq, cbq, crq, damage, new_prev = _encode_body(
-            frame, prev, qy, qc, qsel, stripe_h=stripe_h)
+            frame, prev, qy, qc, qsel, stripe_h=stripe_h,
+            wm_scaled=wm_scaled if watermark else None,
+            alpha_inv=alpha_inv if watermark else None)
         words, nbytes, base, ovf = packer_fn(yq, cbq, crq)
         # One fetchable buffer per frame: 4*S words of metadata followed by
         # the packed bitstream. Tunneled/RPC transports pay ~25-100 ms per
@@ -188,6 +200,8 @@ class JpegStripeEncoder:
         paint_over_trigger_frames: int = 15,
         damage_threshold: int = 0,
         entropy: str = "device",
+        watermark_path: str = "",
+        watermark_location: int = -1,
     ) -> None:
         if stripe_height % 16:
             raise ValueError("stripe_height must be a multiple of 16 (4:2:0 MCUs)")
@@ -211,12 +225,61 @@ class JpegStripeEncoder:
         self._static_frames = np.zeros(self.n_stripes, dtype=np.int64)
         self._painted = np.zeros(self.n_stripes, dtype=bool)
         self._first_frame = True
+        self._wm_scaled, self._alpha_inv = self._load_watermark(
+            watermark_path, watermark_location)
 
         if entropy == "device":
             self._packer, self._step = _device_pipeline(
-                self.pad_h, self.pad_w, self.stripe_h)
+                self.pad_h, self.pad_w, self.stripe_h,
+                watermark=self._wm_scaled is not None)
 
     # -- configuration -----------------------------------------------------
+
+    def _load_watermark(self, path: str, location: int):
+        """Build the full-frame premultiplied overlay (pixelflux watermark
+        parity, reference selkies.py:2959-2962). Locations: 0 TL, 1 TR,
+        2 BL, 3 BR (default), 4 center, 5 middle-left, 6 middle-right."""
+        if not path:
+            return None, None
+        try:
+            from PIL import Image
+
+            img = np.asarray(Image.open(path).convert("RGBA"), np.uint16)
+        except Exception:
+            import logging
+
+            logging.getLogger("selkies_tpu.encoder").warning(
+                "watermark %s unreadable; disabled", path)
+            return None, None
+        wh, ww = img.shape[:2]
+        wh, ww = min(wh, self.pad_h), min(ww, self.pad_w)
+        img = img[:wh, :ww]
+        m = 16  # margin
+        positions = {
+            0: (m, m),
+            1: (m, self.pad_w - ww - m),
+            2: (self.pad_h - wh - m, m),
+            3: (self.pad_h - wh - m, self.pad_w - ww - m),
+            4: ((self.pad_h - wh) // 2, (self.pad_w - ww) // 2),
+            5: ((self.pad_h - wh) // 2, m),
+            6: ((self.pad_h - wh) // 2, self.pad_w - ww - m),
+        }
+        y0, x0 = positions.get(int(location), positions[3])
+        y0, x0 = max(0, y0), max(0, x0)
+        # clamp to the space remaining at the placement (a mark near the
+        # frame edge is cropped, never a broadcast error)
+        wh = min(wh, self.pad_h - y0)
+        ww = min(ww, self.pad_w - x0)
+        if wh <= 0 or ww <= 0:
+            return None, None
+        img = img[:wh, :ww]
+        # integer alpha blend: out = (frame*(255-a) + rgb*a + 127) // 255
+        a = img[:, :, 3:4]
+        wm_scaled = np.zeros((self.pad_h, self.pad_w, 3), np.uint16)
+        wm_scaled[y0:y0 + wh, x0:x0 + ww] = img[:, :, :3] * a
+        alpha_inv = np.full((self.pad_h, self.pad_w, 1), 255, np.uint16)
+        alpha_inv[y0:y0 + wh, x0:x0 + ww] = 255 - a
+        return jnp.asarray(wm_scaled), jnp.asarray(alpha_inv)
 
     def set_quality(self, quality: int, paintover_quality: Optional[int] = None):
         self.quality = int(quality)
@@ -334,7 +397,8 @@ class JpegStripeEncoder:
 
         if self.entropy == "device":
             packed, new_prev, yq, cbq, crq = self._step(
-                jnp.asarray(frame), self._prev, self._qy, self._qc, qsel)
+                jnp.asarray(frame), self._prev, self._qy, self._qc, qsel,
+                self._wm_scaled, self._alpha_inv)
             self._prev = new_prev
             mw = META_WORDS_PER_STRIPE * self.n_stripes
             head_np = np.asarray(packed[:mw])
@@ -354,6 +418,7 @@ class JpegStripeEncoder:
         yq, cbq, crq, damage, new_prev = _device_encode(
             jnp.asarray(frame), self._prev, self._qy, self._qc, qsel,
             stripe_h=self.stripe_h,
+            wm_scaled=self._wm_scaled, alpha_inv=self._alpha_inv,
         )
         self._prev = new_prev
         yq, cbq, crq, damage = (np.asarray(a) for a in (yq, cbq, crq, damage))

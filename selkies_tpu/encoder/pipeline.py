@@ -132,7 +132,8 @@ class PipelinedJpegEncoder:
         b._painted |= paint_candidate
         qsel = jnp.asarray(paint_candidate.astype(np.int32))
         packed, new_prev, yq, cbq, crq = b._step(
-            frame, b._prev, b._qy, b._qc, qsel)
+            frame, b._prev, b._qy, b._qc, qsel,
+            b._wm_scaled, b._alpha_inv)
         b._prev = new_prev
         item = _InFlight(
             seq=self._seq, paint_candidate=paint_candidate,

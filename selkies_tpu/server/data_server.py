@@ -56,6 +56,10 @@ def default_encoder_factory(
     if profile in ("x264enc", "x264enc-striped"):
         from ..encoder.h264 import H264StripeEncoder
 
+        if str(settings.watermark_path):
+            logger.warning(
+                "watermark is implemented in the JPEG profile only; the "
+                "H.264 profiles ignore watermark_path for now")
         crf = int(ov.get("h264_crf", settings.h264_crf.default))
         paint_crf = int(ov.get("h264_paintover_crf",
                                settings.h264_paintover_crf.default))
@@ -78,6 +82,8 @@ def default_encoder_factory(
             use_paint_over_quality=ov.get(
                 "use_paint_over_quality",
                 settings.use_paint_over_quality.value),
+            watermark_path=str(settings.watermark_path),
+            watermark_location=int(settings.watermark_location),
         ),
         depth=3,
     )

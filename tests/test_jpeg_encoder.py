@@ -192,3 +192,60 @@ def test_pipeline_partial_group_flushed_by_poll():
             break
     assert len(got) == 2
     assert all(stripes for _, stripes in got)
+
+
+def test_watermark_overlay(tmp_path):
+    """pixelflux watermark parity: PNG blended on device at the configured
+    location; output decodes with the mark present."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from selkies_tpu.encoder.jpeg import JpegStripeEncoder
+
+    wm = Image.new("RGBA", (32, 16), (255, 0, 0, 255))
+    wm_path = tmp_path / "wm.png"
+    wm.save(wm_path)
+
+    frame = np.full((64, 128, 3), 32, np.uint8)
+    plain = JpegStripeEncoder(128, 64, stripe_height=64, quality=90)
+    marked = JpegStripeEncoder(128, 64, stripe_height=64, quality=90,
+                               watermark_path=str(wm_path),
+                               watermark_location=0)  # top-left
+    out_p = plain.encode_frame(frame)
+    out_m = marked.encode_frame(frame)
+    img_p = np.asarray(Image.open(io.BytesIO(out_p[0].jpeg)).convert("RGB"))
+    img_m = np.asarray(Image.open(io.BytesIO(out_m[0].jpeg)).convert("RGB"))
+    # top-left region (16px margin) turns red; far corner unchanged
+    assert img_m[20, 20, 0] > 180 and img_m[20, 20, 1] < 90
+    assert abs(int(img_p[60, 120, 0]) - int(img_m[60, 120, 0])) < 10
+    # opaque overlay exact: (32*0 + 255*255 + 127)//255 == 255
+    assert img_p[20, 20, 0] < 60
+
+
+def test_watermark_missing_file_disabled(tmp_path):
+    import numpy as np
+
+    from selkies_tpu.encoder.jpeg import JpegStripeEncoder
+
+    enc = JpegStripeEncoder(64, 64, watermark_path=str(tmp_path / "nope.png"))
+    assert enc._wm_scaled is None
+    assert enc.encode_frame(np.zeros((64, 64, 3), np.uint8))
+
+
+def test_watermark_clamped_at_frame_edge(tmp_path):
+    """A mark bigger than the space at its placement is cropped, never a
+    constructor crash (regression)."""
+    import numpy as np
+    from PIL import Image
+
+    from selkies_tpu.encoder.jpeg import JpegStripeEncoder
+
+    wm_path = tmp_path / "big.png"
+    Image.new("RGBA", (64, 64), (0, 255, 0, 255)).save(wm_path)
+    enc = JpegStripeEncoder(64, 64, stripe_height=64,
+                            watermark_path=str(wm_path),
+                            watermark_location=0)
+    assert enc._wm_scaled is not None
+    assert enc.encode_frame(np.zeros((64, 64, 3), np.uint8))

@@ -75,6 +75,10 @@ class SelkiesInput {
     on(this.el, "mouseup", (e) => this._button(e, false));
     on(this.el, "wheel", (e) => this._wheel(e), { passive: false });
     on(this.el, "contextmenu", (e) => e.preventDefault());
+    on(this.el, "touchstart", (e) => this._touch(e, 1), { passive: false });
+    on(this.el, "touchmove", (e) => this._touch(e, 1), { passive: false });
+    on(this.el, "touchend", (e) => this._touch(e, 0), { passive: false });
+    on(this.el, "touchcancel", (e) => this._touch(e, 0), { passive: false });
     on(document, "pointerlockchange",
        () => { this.pointerLocked = document.pointerLockElement === this.el; });
     on(window, "gamepadconnected", (e) => this._gamepadConnected(e));
@@ -125,6 +129,19 @@ class SelkiesInput {
     if (down) this.buttonMask |= bit;
     else this.buttonMask &= ~bit;
     this._motion(ev);
+  }
+
+  /* Single-touch maps to a left-button drag (reference touch mode). */
+  _touch(ev, down) {
+    ev.preventDefault();
+    // on lift, report the finger that left; only release the button once
+    // no touches remain (a brushing second finger must not break a drag)
+    const t = down ? ev.touches[0] : ev.changedTouches[0];
+    if (!t) return;
+    const [x, y] = this._canvasCoords(t);
+    if (down) this.buttonMask |= 1;
+    else if (ev.touches.length === 0) this.buttonMask &= ~1;
+    this.client.send(`m,${x},${y},${this.buttonMask},0`);
   }
 
   _wheel(ev) {
