@@ -1,0 +1,11 @@
+#!/bin/bash
+# Build the conda package and produce a relocatable tarball via conda-pack
+# (parity: the reference's portable distribution flow).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+conda build . --output-folder ./out
+conda create -y -p ./env-pack python=3.12
+conda install -y -p ./env-pack ./out/*/selkies-tpu-*.tar.bz2
+conda pack -p ./env-pack -o selkies-tpu-portable.tar.gz
+echo "portable distribution: $(pwd)/selkies-tpu-portable.tar.gz"

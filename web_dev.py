@@ -1,0 +1,33 @@
+"""Minimal WS echo + static server for client development (parity:
+reference web.py dev harness): serves web/ and echoes every WebSocket
+message back, so the client's connection/demux plumbing can be exercised
+without the full streaming server.
+
+Usage: python web_dev.py [port]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+
+async def main(port: int) -> None:
+    from selkies_tpu.rtc.signaling import SignalingServer
+    import websockets.asyncio.server as ws_server
+
+    web_root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "web")
+    http_server = SignalingServer(addr="0.0.0.0", port=port, web_root=web_root)
+
+    async def echo(ws):
+        async for message in ws:
+            await ws.send(message)
+
+    async with ws_server.serve(echo, "0.0.0.0", port + 2):
+        print(f"static http://0.0.0.0:{port}/  ws-echo :{port + 2}")
+        await http_server.run()
+
+
+if __name__ == "__main__":
+    asyncio.run(main(int(sys.argv[1]) if len(sys.argv) > 1 else 8090))
