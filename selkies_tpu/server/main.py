@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from ..settings import Settings
 from .app import StreamingApp
@@ -18,10 +19,52 @@ def run(settings: Settings) -> int:
     return asyncio.run(_amain(settings)) or 0
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: the first 1080p step costs tens of
+    seconds to compile; across restarts it should cost a disk read."""
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "SELKIES_JAX_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "selkies-tpu-xla"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        logging.getLogger("selkies_tpu").debug("compile cache unavailable")
+
+
+def _warm_default_geometry(settings: Settings) -> None:
+    """Background-compile the default encoder geometry so the first client
+    doesn't pay the jit stall on the event loop."""
+    import threading
+
+    def work():
+        try:
+            from ..server.data_server import default_encoder_factory
+
+            enc = default_encoder_factory(1920, 1080, settings)
+            import numpy as np
+
+            enc.submit(np.zeros((1080, 1920, 3), np.uint8))
+            enc.flush()
+            close = getattr(enc, "close", None)
+            if close:
+                close()
+            logging.getLogger("selkies_tpu").info("encoder warm-up done")
+        except Exception:
+            logging.getLogger("selkies_tpu").debug("warm-up skipped")
+
+    threading.Thread(target=work, name="tpuenc-warmup", daemon=True).start()
+
+
 async def _amain(settings: Settings) -> int:
+    _enable_compile_cache()
     app = StreamingApp(settings)
     server = DataStreamingServer(settings, app=app)
     app.data_server = server
+    _warm_default_geometry(settings)
 
     if settings.audio_enabled.value:
         try:
