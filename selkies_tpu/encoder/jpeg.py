@@ -113,10 +113,14 @@ def _device_pipeline(pad_h: int, pad_w: int, stripe_h: int,
     shared event loop otherwise)."""
     from .device_entropy import DeviceEntropyPacker
 
-    # Streaming fast path: 16-word (512-bit) per-block budget. Blocks beyond
-    # it (dense high-quality content) flag their stripe, which falls back to
-    # the host coder in _scans_from_packed — output stays bit-exact.
-    packer = DeviceEntropyPacker(pad_h, pad_w, stripe_h, block_words=16)
+    # Streaming fast path: 16-word (512-bit) per-block budget and a 16 KB
+    # per-stripe cap (typical q40 1080p stripes are ~3 KB; the boundary
+    # machinery costs ~10 ns per word-slot, so halving the cap buys ~3 ms
+    # per frame). Blocks/stripes beyond either budget flag their stripe,
+    # which falls back to the host coder in _scans_from_packed — output
+    # stays bit-exact.
+    packer = DeviceEntropyPacker(pad_h, pad_w, stripe_h, block_words=16,
+                                 max_stripe_bytes=1 << 14)
     packer_fn = packer._pack_fn
     n_stripes = pad_h // stripe_h
 
