@@ -88,3 +88,36 @@ def test_web_root_served_over_http():
         task.cancel()
 
     asyncio.run(run())
+
+
+def test_gendb_parses_sdl_mappings(tmp_path):
+    """gendb parity: SDL GUID vendor/product extraction + per-device JSON."""
+    import json
+    import subprocess
+    import sys
+
+    # xbox 360 pad GUID: bus 03, vendor 045e (LE: 5e04), product 028e (8e02)
+    db = tmp_path / "db.txt"
+    db.write_text(
+        "# comment line\n"
+        "030000005e0400008e02000014010000,X360 Controller,"
+        "a:b0,b:b1,x:b2,y:b3,leftx:a0,lefty:a1,platform:Linux,\n"
+        "030000005e0400008e02000014010000,Mac pad,a:b0,platform:Mac OS X,\n")
+    out = tmp_path / "out"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "gendb.py"),
+         str(db), str(out)], capture_output=True)
+    assert r.returncode == 0, r.stderr.decode()
+    written = list(out.iterdir())
+    assert len(written) == 1
+    entry = json.loads(written[0].read_text())
+    assert entry["vendor"] == "045e" and entry["product"] == "028e"
+    assert entry["mapping"]["a"] == "b0"
+    assert entry["mapping"]["leftx"] == "a0"
+
+
+def test_touch_gamepad_contract():
+    js = read("touch-gamepad.js")
+    assert "getGamepads" in js
+    assert "gamepadconnected" in js and "gamepaddisconnected" in js
+    assert '"standard"' in js     # mapping: standard-gamepad layout

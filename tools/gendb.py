@@ -1,0 +1,88 @@
+"""Convert SDL_GameControllerDB mappings into per-vendor-product JSON files.
+
+Role parity with the reference's ``addons/gst-web-core/gendb.js``: the web
+client (or server gamepad mapper) looks up a controller's button/axis
+layout by USB vendor:product; this tool splits the community
+`gamecontrollerdb.txt` into one small JSON per device so clients fetch
+only the mapping they need.
+
+Usage:
+  python tools/gendb.py gamecontrollerdb.txt out_dir/
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, Optional, Tuple
+
+
+def parse_guid(guid: str) -> Optional[Tuple[str, str]]:
+    """SDL GUIDs encode bus/vendor/product/version as little-endian hex
+    words; vendor is bytes 8-10, product bytes 16-18 (hex string offsets)."""
+    if len(guid) != 32:
+        return None
+    try:
+        vendor = guid[10:12] + guid[8:10]
+        product = guid[18:20] + guid[16:18]
+    except IndexError:
+        return None
+    if vendor == "0000" and product == "0000":
+        return None
+    return vendor.lower(), product.lower()
+
+
+def parse_line(line: str) -> Optional[Dict]:
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    parts = line.split(",")
+    if len(parts) < 3:
+        return None
+    guid, name = parts[0], parts[1]
+    ids = parse_guid(guid)
+    mapping: Dict[str, str] = {}
+    platform = ""
+    for field in parts[2:]:
+        if ":" not in field:
+            continue
+        key, _, value = field.partition(":")
+        if key == "platform":
+            platform = value
+        elif key:
+            mapping[key] = value
+    if platform and platform != "Linux":
+        return None
+    return {
+        "guid": guid,
+        "name": name,
+        "vendor": ids[0] if ids else None,
+        "product": ids[1] if ids else None,
+        "mapping": mapping,
+    }
+
+
+def main(argv) -> int:
+    if len(argv) != 3:
+        print(__doc__)
+        return 2
+    src, out_dir = argv[1], argv[2]
+    os.makedirs(out_dir, exist_ok=True)
+    count = 0
+    with open(src, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            entry = parse_line(line)
+            if entry is None or not entry["vendor"]:
+                continue
+            path = os.path.join(
+                out_dir, f"{entry['vendor']}-{entry['product']}.json")
+            with open(path, "w") as out:
+                json.dump(entry, out, indent=1)
+            count += 1
+    print(f"wrote {count} device mappings to {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

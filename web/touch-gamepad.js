@@ -1,0 +1,157 @@
+/*
+ * touch-gamepad.js — fullscreen multi-touch overlay that fakes a standard
+ * gamepad into navigator.getGamepads().
+ *
+ * Role parity with the reference's addons/universal-touch-gamepad
+ * (universalTouchGamepad.js, 863 LoC): a left virtual stick (axes 0/1), a
+ * right cluster of A/B/X/Y buttons, shoulder buttons, and start/select,
+ * surfaced through a getGamepads() patch so the existing SelkiesInput
+ * gamepad polling ships events unchanged. Enable with
+ * `TouchGamepad.enable(canvas)`, disable to restore the native API.
+ */
+
+"use strict";
+
+const TouchGamepad = (() => {
+  const state = {
+    enabled: false,
+    overlay: null,
+    nativeGetGamepads: null,
+    pad: null,
+    touches: new Map(),   // identifier -> control
+  };
+
+  function makePad() {
+    return {
+      id: "Selkies Touch Gamepad (virtual)",
+      index: 0,
+      connected: true,
+      mapping: "standard",
+      timestamp: performance.now(),
+      axes: [0, 0, 0, 0],
+      buttons: Array.from({ length: 17 }, () => ({
+        pressed: false, touched: false, value: 0 })),
+    };
+  }
+
+  // layout: fractions of viewport; [kind, payload, cx, cy, radius]
+  const CONTROLS = [
+    ["stick", null, 0.18, 0.72, 0.13],
+    ["button", 0, 0.88, 0.72, 0.055],   // A
+    ["button", 1, 0.94, 0.62, 0.055],   // B
+    ["button", 2, 0.82, 0.62, 0.055],   // X
+    ["button", 3, 0.88, 0.52, 0.055],   // Y
+    ["button", 4, 0.12, 0.38, 0.06],    // LB
+    ["button", 5, 0.88, 0.38, 0.06],    // RB
+    ["button", 8, 0.42, 0.88, 0.045],   // select
+    ["button", 9, 0.58, 0.88, 0.045],   // start
+  ];
+
+  function controlAt(x, y, w, h) {
+    for (const c of CONTROLS) {
+      const [kind, payload, fx, fy, fr] = c;
+      const dx = x - fx * w;
+      const dy = y - fy * h;
+      const r = fr * Math.min(w, h) * 2.2;   // generous hit area
+      if (dx * dx + dy * dy < r * r) return { kind, payload, fx, fy, fr };
+    }
+    return null;
+  }
+
+  function onTouch(ev) {
+    ev.preventDefault();
+    const w = window.innerWidth;
+    const h = window.innerHeight;
+    const pad = state.pad;
+    for (const t of ev.changedTouches) {
+      if (ev.type === "touchstart") {
+        const ctl = controlAt(t.clientX, t.clientY, w, h);
+        if (ctl) state.touches.set(t.identifier, ctl);
+      }
+      const ctl = state.touches.get(t.identifier);
+      if (!ctl) continue;
+      if (ctl.kind === "stick") {
+        if (ev.type === "touchend" || ev.type === "touchcancel") {
+          pad.axes[0] = pad.axes[1] = 0;
+          state.touches.delete(t.identifier);
+        } else {
+          const r = ctl.fr * Math.min(w, h);
+          pad.axes[0] = Math.max(-1, Math.min(1, (t.clientX - ctl.fx * w) / r));
+          pad.axes[1] = Math.max(-1, Math.min(1, (t.clientY - ctl.fy * h) / r));
+        }
+      } else {
+        const down = ev.type === "touchstart" || ev.type === "touchmove";
+        const b = pad.buttons[ctl.payload];
+        b.pressed = b.touched = down;
+        b.value = down ? 1 : 0;
+        if (!down) state.touches.delete(t.identifier);
+      }
+    }
+    pad.timestamp = performance.now();
+  }
+
+  function drawOverlay(el) {
+    el.innerHTML = "";
+    const w = window.innerWidth;
+    const h = window.innerHeight;
+    for (const [kind, payload, fx, fy, fr] of CONTROLS) {
+      const d = document.createElement("div");
+      const r = fr * Math.min(w, h);
+      d.style.cssText =
+        "position:absolute;border:2px solid rgba(255,255,255,.45);" +
+        "border-radius:50%;background:rgba(255,255,255,.08);" +
+        "display:flex;align-items:center;justify-content:center;" +
+        "color:rgba(255,255,255,.6);font:12px system-ui;" +
+        `left:${fx * w - r}px;top:${fy * h - r}px;` +
+        `width:${2 * r}px;height:${2 * r}px;`;
+      d.textContent = kind === "stick" ? "" :
+        ({0: "A", 1: "B", 2: "X", 3: "Y", 4: "LB", 5: "RB",
+          8: "SEL", 9: "ST"})[payload] || "";
+      el.appendChild(d);
+    }
+  }
+
+  function enable() {
+    if (state.enabled) return;
+    state.enabled = true;
+    state.pad = makePad();
+    const el = document.createElement("div");
+    el.style.cssText = "position:fixed;inset:0;z-index:50;touch-action:none;";
+    drawOverlay(el);
+    for (const t of ["touchstart", "touchmove", "touchend", "touchcancel"]) {
+      el.addEventListener(t, onTouch, { passive: false });
+    }
+    document.body.appendChild(el);
+    state.overlay = el;
+    window.addEventListener("resize", () => drawOverlay(el));
+
+    state.nativeGetGamepads = navigator.getGamepads.bind(navigator);
+    navigator.getGamepads = () => {
+      const pads = Array.from(state.nativeGetGamepads() || []);
+      pads[0] = state.pad;
+      return pads;
+    };
+    window.dispatchEvent(new CustomEvent("gamepadconnected", {
+      detail: null }));
+    // SelkiesInput listens for the standard event shape:
+    const ev = new Event("gamepadconnected");
+    ev.gamepad = state.pad;
+    window.dispatchEvent(ev);
+  }
+
+  function disable() {
+    if (!state.enabled) return;
+    state.enabled = false;
+    if (state.overlay) state.overlay.remove();
+    if (state.nativeGetGamepads) {
+      navigator.getGamepads = state.nativeGetGamepads;
+    }
+    const ev = new Event("gamepaddisconnected");
+    ev.gamepad = state.pad;
+    window.dispatchEvent(ev);
+  }
+
+  return { enable, disable };
+})();
+
+if (typeof module !== "undefined") module.exports = { TouchGamepad };
