@@ -159,19 +159,33 @@ def encode_picture_nals(out: dev.StripeEncodeOut, *, is_idr: bool,
                         mb_w: int, mb_h: int, qp: int, frame_num: int,
                         idr_pic_id: int = 0) -> bytes:
     """Run the native CAVLC coder over one stripe's device outputs."""
+    return encode_picture_nals_np(
+        np.ascontiguousarray(np.asarray(out.mv), np.int32),
+        np.ascontiguousarray(np.asarray(out.luma), np.int32),
+        np.ascontiguousarray(np.asarray(out.luma_dc), np.int32),
+        np.ascontiguousarray(np.asarray(out.chroma_dc), np.int32),
+        np.ascontiguousarray(np.asarray(out.chroma_ac), np.int32),
+        is_idr=is_idr, mb_w=mb_w, mb_h=mb_h, qp=qp,
+        frame_num=frame_num, idr_pic_id=idr_pic_id)
+
+
+def encode_picture_nals_np(mv, luma, luma_dc, chroma_dc, chroma_ac, *,
+                           is_idr: bool, mb_w: int, mb_h: int, qp: int,
+                           frame_num: int, idr_pic_id: int = 0) -> bytes:
+    """CAVLC over host-resident coefficient arrays (already fetched)."""
     lib = cavlc_lib()
     if lib is None:
         raise RuntimeError("native CAVLC coder unavailable")
-    mv = np.ascontiguousarray(np.asarray(out.mv), np.int32)
-    luma = np.ascontiguousarray(np.asarray(out.luma), np.int32)
-    luma_dc = np.ascontiguousarray(np.asarray(out.luma_dc), np.int32)
-    chroma_dc = np.ascontiguousarray(np.asarray(out.chroma_dc), np.int32)
-    chroma_ac = np.ascontiguousarray(np.asarray(out.chroma_ac), np.int32)
     cap = 1 << 22
     buf = np.empty(cap, np.uint8)
     n = lib.h264_encode_picture(
         1 if is_idr else 0, mb_w, mb_h, qp, frame_num & 0xF, idr_pic_id,
-        mv, luma, luma_dc, chroma_dc, chroma_ac, buf, cap)
+        np.ascontiguousarray(mv, np.int32),
+        np.ascontiguousarray(luma, np.int32),
+        np.ascontiguousarray(luma_dc, np.int32),
+        np.ascontiguousarray(chroma_dc, np.int32),
+        np.ascontiguousarray(chroma_ac, np.int32),
+        buf, cap)
     if n < 0:
         raise RuntimeError("CAVLC output exceeded capacity")
     return bytes(buf[:n])
@@ -269,7 +283,9 @@ class H264StripeEncoder:
         y_full, cb_full, cr_full = dev.prepare_planes(
             rgb, self.height, self.pad_w)
 
-        out: List[H264Stripe] = []
+        # Phase 1 — dispatch every damaged stripe's device encode (async;
+        # dispatches pipeline on the device stream).
+        pending = []     # (st, enc_out, is_idr, qp)
         for i, st in enumerate(self.stripes):
             paint_over = False
             if not damage[i] and not st.need_idr:
@@ -287,40 +303,87 @@ class H264StripeEncoder:
             sy = _pad_stripe(y_full, st.y0, st.h, st.pad_h)
             scb = _pad_stripe(cb_full, st.y0 // 2, st.h // 2, st.pad_h // 2)
             scr = _pad_stripe(cr_full, st.y0 // 2, st.h // 2, st.pad_h // 2)
-
             qp = self.paint_over_qp if paint_over else self.qp
-            mb_w = self.pad_w // MB
-            mb_h = st.pad_h // MB
             if st.need_idr or st.ref_y is None:
                 enc = dev.encode_stripe_idr(sy, scb, scr, qp)
-                nals = encode_picture_nals(
-                    enc, is_idr=True, mb_w=mb_w, mb_h=mb_h, qp=qp,
+                pending.append((st, enc, True, qp))
+            else:
+                enc = dev.encode_stripe_p(
+                    sy, scb, scr, st.ref_y, st.ref_cb, st.ref_cr, qp,
+                    self.search)
+                pending.append((st, enc, False, qp))
+
+        if not pending:
+            return []
+
+        # Phase 2 — ONE device concat + ONE host read for every stripe's
+        # coefficients (i16 halves the transfer; levels/MVs fit easily).
+        # Per-fetch latency dominates RPC-attached devices: the naive
+        # per-array asarray() path costs 5 reads × stripes per frame.
+        # Each stripe flattens through a per-geometry jitted pack so the
+        # final concatenate only varies with the pending COUNT, not with
+        # which subset of stripes was damaged.
+        chunks = []
+        splits = []
+        for st, enc, is_idr, qp in pending:
+            arrs = (enc.mv, enc.luma, enc.luma_dc, enc.chroma_dc,
+                    enc.chroma_ac)
+            shapes = [a.shape for a in arrs]
+            sizes = [int(np.prod(s)) for s in shapes]
+            splits.append((shapes, sizes))
+            chunks.append(_flatten_stripe_i16(*arrs))
+        flat = np.asarray(
+            chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks))
+
+        out: List[H264Stripe] = []
+        pos = 0
+        mb_w = self.pad_w // MB
+        for (st, enc, is_idr, qp), (shapes, sizes) in zip(pending, splits):
+            parts = []
+            for shape, size in zip(shapes, sizes):
+                parts.append(flat[pos:pos + size].reshape(shape)
+                             .astype(np.int32))
+                pos += size
+            mv, luma, luma_dc, chroma_dc, chroma_ac = parts
+            mb_h = st.pad_h // MB
+            if is_idr:
+                nals = encode_picture_nals_np(
+                    mv, luma, luma_dc, chroma_dc, chroma_ac,
+                    is_idr=True, mb_w=mb_w, mb_h=mb_h, qp=qp,
                     frame_num=0, idr_pic_id=st.idr_pic_id)
                 payload = self._sps_pps_for(st) + nals
                 st.frame_num = 1
                 st.idr_pic_id = (st.idr_pic_id + 1) % 16
                 st.need_idr = False
-                is_key = True
             else:
-                enc = dev.encode_stripe_p(
-                    sy, scb, scr, st.ref_y, st.ref_cb, st.ref_cr, qp,
-                    self.search)
-                payload = encode_picture_nals(
-                    enc, is_idr=False, mb_w=mb_w, mb_h=mb_h, qp=qp,
+                payload = encode_picture_nals_np(
+                    mv, luma, luma_dc, chroma_dc, chroma_ac,
+                    is_idr=False, mb_w=mb_w, mb_h=mb_h, qp=qp,
                     frame_num=st.frame_num)
                 st.frame_num = (st.frame_num + 1) % 16
-                is_key = False
+            # commit the reference ONLY once the bitstream for this stripe
+            # exists: an entropy failure must not leave the encoder
+            # predicting from a reconstruction the decoder never got
             st.ref_y, st.ref_cb, st.ref_cr = (
                 enc.recon_y, enc.recon_cb, enc.recon_cr)
             out.append(H264Stripe(
                 y_start=st.y0, width=self.width, height=st.h,
-                annexb=payload, is_key=is_key))
+                annexb=payload, is_key=is_idr))
         return out
 
     def request_keyframe(self) -> None:
         """Force IDR on every stripe (client join / PIPELINE_RESETTING)."""
         for st in self.stripes:
             st.need_idr = True
+
+
+@jax.jit
+def _flatten_stripe_i16(mv, luma, luma_dc, chroma_dc, chroma_ac):
+    """One stripe's device outputs → one flat i16 buffer (fixed shape per
+    stripe geometry, so the cross-stripe concatenate stays shape-stable)."""
+    return jnp.concatenate([
+        a.reshape(-1).astype(jnp.int16)
+        for a in (mv, luma, luma_dc, chroma_dc, chroma_ac)])
 
 
 @functools.partial(jax.jit, static_argnames=("y0s", "hs"))

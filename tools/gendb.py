@@ -23,11 +23,8 @@ def parse_guid(guid: str) -> Optional[Tuple[str, str]]:
     words; vendor is bytes 8-10, product bytes 16-18 (hex string offsets)."""
     if len(guid) != 32:
         return None
-    try:
-        vendor = guid[10:12] + guid[8:10]
-        product = guid[18:20] + guid[16:18]
-    except IndexError:
-        return None
+    vendor = guid[10:12] + guid[8:10]
+    product = guid[18:20] + guid[16:18]
     if vendor == "0000" and product == "0000":
         return None
     return vendor.lower(), product.lower()

@@ -21,10 +21,12 @@ const TouchGamepad = (() => {
     touches: new Map(),   // identifier -> control
   };
 
+  const VIRTUAL_INDEX = 3;   // stay clear of physical pads at 0-2
+
   function makePad() {
     return {
       id: "Selkies Touch Gamepad (virtual)",
-      index: 0,
+      index: VIRTUAL_INDEX,
       connected: true,
       mapping: "standard",
       timestamp: performance.now(),
@@ -123,17 +125,16 @@ const TouchGamepad = (() => {
     }
     document.body.appendChild(el);
     state.overlay = el;
-    window.addEventListener("resize", () => drawOverlay(el));
+    state.onResize = () => drawOverlay(el);
+    window.addEventListener("resize", state.onResize);
 
     state.nativeGetGamepads = navigator.getGamepads.bind(navigator);
     navigator.getGamepads = () => {
       const pads = Array.from(state.nativeGetGamepads() || []);
-      pads[0] = state.pad;
+      while (pads.length <= VIRTUAL_INDEX) pads.push(null);
+      pads[VIRTUAL_INDEX] = state.pad;   // never clobber a physical pad
       return pads;
     };
-    window.dispatchEvent(new CustomEvent("gamepadconnected", {
-      detail: null }));
-    // SelkiesInput listens for the standard event shape:
     const ev = new Event("gamepadconnected");
     ev.gamepad = state.pad;
     window.dispatchEvent(ev);
@@ -142,6 +143,7 @@ const TouchGamepad = (() => {
   function disable() {
     if (!state.enabled) return;
     state.enabled = false;
+    if (state.onResize) window.removeEventListener("resize", state.onResize);
     if (state.overlay) state.overlay.remove();
     if (state.nativeGetGamepads) {
       navigator.getGamepads = state.nativeGetGamepads;
