@@ -28,7 +28,7 @@ from .jitterbuffer import JitterBuffer
 from .opus import OpusDepayloader, OpusPayloader
 from .rate import GccEstimator
 from .rtp import (RtcpNack, RtcpPli, RtcpReceiverReport, RtcpSenderReport,
-                  RtpPacket, is_rtcp, parse_rtcp)
+                  RtcpTwcc, RtpPacket, is_rtcp, pack_twcc_seq, parse_rtcp)
 from .sctp import DataChannel, SctpAssociation
 from .sdp import (MediaSection, SessionDescription, default_audio_codecs,
                   default_video_codecs)
@@ -39,6 +39,8 @@ logger = logging.getLogger("selkies_tpu.webrtc.pc")
 VIDEO_PT = 102
 AUDIO_PT = 111
 VIDEO_CLOCK = 90000
+TWCC_EXT_ID = 2          # matches the a=extmap we offer in _describe
+TWCC_HISTORY = 2048      # sent-packet records kept for feedback matching
 
 
 class MediaSender:
@@ -67,6 +69,8 @@ class MediaSender:
         self._last_rtp_ts = timestamp & 0xFFFFFFFF
         self._last_send_wall = time.time()
         for pkt in packets:
+            # transport-wide sequencing feeds the sender-side GCC estimator
+            pkt.extensions[TWCC_EXT_ID] = pack_twcc_seq(self.pc._next_twcc())
             raw = pkt.serialize()
             self.packet_count += 1
             self.octet_count += len(pkt.payload)
@@ -127,6 +131,11 @@ class PeerConnection:
         self.srtp_tx: Optional[SrtpContext] = None
         self.srtp_rx: Optional[SrtpContext] = None
         self.gcc = GccEstimator()
+        self._twcc_seq = 0
+        self._twcc_sent: Dict[int, Tuple[float, int]] = {}  # seq -> (ms, size)
+        self._twcc_recv: Dict[int, int] = {}   # seq -> arrival (µs)
+        self._twcc_fb_count = 0
+        self._twcc_recv_ssrc = 0
 
         self.senders: Dict[int, MediaSender] = {}      # ssrc -> sender
         self.receivers: Dict[int, MediaReceiver] = {}  # payload type -> recv
@@ -319,6 +328,8 @@ class PeerConnection:
             if now - last_sr > 2.0 and self.srtp_tx is not None:
                 last_sr = now
                 self._send_sender_reports(now)
+            if self._twcc_recv and self.srtp_tx is not None:
+                self._send_twcc_feedback()
             await asyncio.sleep(0.05)
 
     # ------------------------------------------------------------- demux
@@ -344,9 +355,25 @@ class PeerConnection:
             pkt = RtpPacket.parse(plain)
         except ValueError:
             return
+        ext = pkt.extensions.get(TWCC_EXT_ID)
+        if ext is not None and len(ext) == 2:
+            seq = int.from_bytes(ext, "big")
+            self._twcc_recv[seq] = int(time.monotonic() * 1e6)
+            self._twcc_recv_ssrc = pkt.ssrc
         recv = self.receivers.get(pkt.payload_type)
         if recv is not None:
             recv.feed(pkt)
+
+    def _next_twcc(self) -> int:
+        seq = self._twcc_seq
+        self._twcc_seq = (self._twcc_seq + 1) & 0xFFFF
+        return seq
+
+    def _record_twcc_send(self, seq: int, size: int) -> None:
+        self._twcc_sent[seq] = (time.monotonic() * 1000.0, size)
+        if len(self._twcc_sent) > TWCC_HISTORY:
+            for k in sorted(self._twcc_sent)[:len(self._twcc_sent) // 2]:
+                del self._twcc_sent[k]
 
     def _handle_rtcp(self, data: bytes) -> None:
         try:
@@ -359,6 +386,10 @@ class PeerConnection:
             elif isinstance(pkt, RtcpReceiverReport):
                 for r in pkt.reports:
                     self.gcc.add_loss_report(r.fraction_lost / 256.0)
+                if self.on_bitrate:
+                    self.on_bitrate(self.gcc.bitrate)
+            elif isinstance(pkt, RtcpTwcc):
+                self.gcc.feed_twcc(pkt.received, self._twcc_sent)
                 if self.on_bitrate:
                     self.on_bitrate(self.gcc.bitrate)
             elif isinstance(pkt, RtcpNack):
@@ -377,6 +408,8 @@ class PeerConnection:
     def _send_rtp(self, raw: bytes) -> None:
         if self.srtp_tx is None:
             return
+        # record the just-assigned transport seq against the wire size
+        self._record_twcc_send((self._twcc_seq - 1) & 0xFFFF, len(raw))
         try:
             self.ice.send(self.srtp_tx.protect_rtp(raw))
         except ConnectionError:
@@ -393,6 +426,30 @@ class PeerConnection:
                 self.ice.send(self.srtp_tx.protect_rtcp(sr.serialize()))
             except (ConnectionError, ValueError):
                 pass
+
+    def _send_twcc_feedback(self) -> None:
+        """Ship transport-wide-cc feedback for packets received since the
+        last report (the signal the remote GCC estimator runs on)."""
+        recv, self._twcc_recv = self._twcc_recv, {}
+        seqs = sorted(recv)
+        base = seqs[0]
+        span = (seqs[-1] - base) & 0xFFFF
+        if span > 500:   # wrap/garbage guard: report the head run only
+            seqs = [s for s in seqs if ((s - base) & 0xFFFF) <= 500]
+            span = (seqs[-1] - base) & 0xFFFF
+        received = [((base + i) & 0xFFFF, recv.get((base + i) & 0xFFFF))
+                    for i in range(span + 1)]
+        ref_us = min(t for _, t in received if t is not None)
+        fb = RtcpTwcc(
+            sender_ssrc=1, media_ssrc=self._twcc_recv_ssrc,
+            base_seq=base, fb_count=self._twcc_fb_count & 0xFF,
+            ref_time=(ref_us // 64000) & 0xFFFFFF,
+            received=received)
+        self._twcc_fb_count += 1
+        try:
+            self.ice.send(self.srtp_tx.protect_rtcp(fb.serialize()))
+        except (ConnectionError, ValueError):
+            pass
 
     def request_keyframe(self, media_ssrc: int) -> None:
         if self.srtp_tx is None:

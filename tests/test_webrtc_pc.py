@@ -77,6 +77,15 @@ def test_peerconnection_end_to_end():
             await asyncio.sleep(0.05)
         assert got_input == [b"kd,65", b"\x02binary"]
 
+        # TWCC loop closed: the answerer fed back arrival times and the
+        # offerer's sender-side GCC estimator consumed them
+        for _ in range(100):
+            if offerer.gcc.delay._recv_window:
+                break
+            await asyncio.sleep(0.05)
+        assert offerer.gcc.delay._recv_window, "no TWCC feedback reached GCC"
+        assert offerer.gcc.bitrate > 0
+
         await offerer.close()
         await answerer.close()
 
