@@ -1,0 +1,12 @@
+"""Server plane: data/control server, entry points, WebRTC session app."""
+
+import os
+
+
+def bundled_web_root():
+    """Absolute path of the bundled web client, or None when not shipped
+    (e.g. a bare wheel install without the repo's web/ directory)."""
+    root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "web")
+    return root if os.path.isdir(root) else None

@@ -111,14 +111,11 @@ async def _amain(settings: Settings) -> int:
     # web port (reference: signalling_web.py serves gst-web on 8080)
     web_server = None
     try:
-        import os
-
         from ..rtc import SignalingServer
+        from . import bundled_web_root
 
-        web_root = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))), "web")
-        if os.path.isdir(web_root):
+        web_root = bundled_web_root()
+        if web_root is not None:
             web_server = SignalingServer(
                 addr="0.0.0.0", port=int(settings.web_port),
                 web_root=web_root,
@@ -139,8 +136,7 @@ async def _amain(settings: Settings) -> int:
             tasks.append(asyncio.create_task(_run_web()))
         else:
             logging.getLogger("selkies_tpu").warning(
-                "web client assets not found at %s; HTTP serving disabled",
-                web_root)
+                "web client assets not bundled; HTTP serving disabled")
     except Exception:
         logging.getLogger("selkies_tpu").exception("web server init failed")
 

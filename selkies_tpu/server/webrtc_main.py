@@ -25,12 +25,11 @@ async def _amain(settings: Settings) -> int:
     from ..rtc import HMACRTCMonitor, SignalingServer
     from .webrtc_app import WebRTCStreamingApp
 
-    web_root = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "web")
+    from . import bundled_web_root
+
     signaling = SignalingServer(
         addr="0.0.0.0", port=int(settings.web_port),
-        web_root=web_root if os.path.isdir(web_root) else None,
+        web_root=bundled_web_root(),
         turn_shared_secret=str(settings.turn_shared_secret),
         turn_host=str(settings.turn_host),
         turn_port=str(settings.turn_port),
