@@ -27,8 +27,9 @@ from .ice import Candidate, IceAgent
 from .jitterbuffer import JitterBuffer
 from .opus import OpusDepayloader, OpusPayloader
 from .rate import GccEstimator
-from .rtp import (RtcpNack, RtcpPli, RtcpReceiverReport, RtcpSenderReport,
-                  RtcpTwcc, RtpPacket, is_rtcp, pack_twcc_seq, parse_rtcp)
+from .rtp import (RtcpNack, RtcpPli, RtcpReceiverReport, RtcpRemb,
+                  RtcpSenderReport, RtcpTwcc, RtpPacket, is_rtcp,
+                  pack_twcc_seq, parse_rtcp)
 from .sctp import DataChannel, SctpAssociation
 from .sdp import (MediaSection, SessionDescription, default_audio_codecs,
                   default_video_codecs)
@@ -60,6 +61,8 @@ class MediaSender:
             else OpusPayloader()
         self._last_rtp_ts: Optional[int] = None
         self._last_send_wall: float = 0.0
+        #: recent wire packets for NACK retransmission (seq -> raw RTP)
+        self._sent: Dict[int, bytes] = {}
 
     def send_frame(self, payload: bytes, timestamp: int) -> None:
         """Packetize + protect + ship one encoded frame/AU."""
@@ -74,7 +77,21 @@ class MediaSender:
             raw = pkt.serialize()
             self.packet_count += 1
             self.octet_count += len(pkt.payload)
+            self._sent[pkt.sequence_number] = raw
+            if len(self._sent) > 512:
+                for k in sorted(self._sent)[:256]:
+                    del self._sent[k]
             self.pc._send_rtp(raw)
+
+    def resend(self, sequence_numbers) -> int:
+        """NACK retransmission from the recent-packet buffer."""
+        n = 0
+        for seq in sequence_numbers:
+            raw = self._sent.get(seq & 0xFFFF)
+            if raw is not None:
+                self.pc._send_rtp(raw)
+                n += 1
+        return n
 
     def sender_report(self, now_wall: float) -> Optional[RtcpSenderReport]:
         """SR with an honest NTP↔RTP mapping: the receiver uses this pair
@@ -330,6 +347,7 @@ class PeerConnection:
                 self._send_sender_reports(now)
             if self._twcc_recv and self.srtp_tx is not None:
                 self._send_twcc_feedback()
+            self._send_nacks()
             await asyncio.sleep(0.05)
 
     # ------------------------------------------------------------- demux
@@ -392,8 +410,15 @@ class PeerConnection:
                 self.gcc.feed_twcc(pkt.received, self._twcc_sent)
                 if self.on_bitrate:
                     self.on_bitrate(self.gcc.bitrate)
+            elif isinstance(pkt, RtcpRemb):
+                self.gcc.loss.bitrate = min(
+                    self.gcc.loss.bitrate, max(150_000, pkt.bitrate))
+                if self.on_bitrate:
+                    self.on_bitrate(self.gcc.bitrate)
             elif isinstance(pkt, RtcpNack):
-                pass  # retransmission buffer: future work
+                sender = self.senders.get(pkt.media_ssrc)
+                if sender is not None:
+                    sender.resend(pkt.lost)
 
     def _dtls_send(self, data: bytes) -> None:
         try:
@@ -426,6 +451,26 @@ class PeerConnection:
                 self.ice.send(self.srtp_tx.protect_rtcp(sr.serialize()))
             except (ConnectionError, ValueError):
                 pass
+
+    def _send_nacks(self) -> None:
+        """Request retransmission of jitter-buffer gaps (video only; audio
+        rides concealment)."""
+        recv = self.receivers.get(VIDEO_PT)
+        if recv is None or self.srtp_tx is None:
+            return
+        missing = recv.jitter.missing()
+        if not missing or len(missing) > 64:   # burst loss → PLI instead
+            if missing and recv.last_ssrc:
+                self.request_keyframe(recv.last_ssrc)
+                recv.jitter.skip_to(
+                    (recv.jitter._last_unwrapped + 1) & 0xFFFF)
+            return
+        nack = RtcpNack(sender_ssrc=1, media_ssrc=recv.last_ssrc,
+                        lost=missing)
+        try:
+            self.ice.send(self.srtp_tx.protect_rtcp(nack.serialize()))
+        except (ConnectionError, ValueError):
+            pass
 
     def _send_twcc_feedback(self) -> None:
         """Ship transport-wide-cc feedback for packets received since the

@@ -120,3 +120,47 @@ def test_peerconnection_bidirectional_media():
         await b.close()
 
     asyncio.run(run())
+
+
+def test_nack_retransmission_recovers_loss():
+    """A dropped media packet is NACKed by the receiver and resent from
+    the sender's retransmission buffer, so the frame still assembles."""
+    async def run():
+        a = PeerConnection(interfaces=["127.0.0.1"])
+        b = PeerConnection(interfaces=["127.0.0.1"])
+        video = a.add_video_sender(ssrc=0xAB)
+        got = []
+        b.video_receiver().on_frame = lambda f, ts: got.append(f)
+
+        offer = await a.create_offer()
+        await b.set_remote_description(offer, "offer")
+        answer = await b.create_answer()
+        await a.set_remote_description(answer, "answer")
+        await asyncio.gather(a.wait_connected(15), b.wait_connected(15))
+
+        # drop the first large outgoing SRTP packet (an FU-A fragment of
+        # a multi-packet frame, so a later packet exposes the gap)
+        real_send = a.ice.send
+        dropped = {"n": 0}
+
+        def lossy(data):
+            if 128 <= data[0] <= 191 and dropped["n"] == 0 and len(data) > 900:
+                dropped["n"] = 1
+                return
+            real_send(data)
+        a.ice.send = lossy
+
+        big_idr = bytes([0x65]) + b"\x77" * 3000   # 3 FU-A packets
+        au = b"\x00\x00\x00\x01" + bytes([0x67, 1, 2, 3]) \
+            + b"\x00\x00\x00\x01" + big_idr
+        video.send_frame(au, timestamp=1000)
+        for _ in range(200):
+            if got:
+                break
+            await asyncio.sleep(0.05)
+        assert dropped["n"] == 1, "test did not exercise a drop"
+        assert got and b"\x77" in got[0], "NACK retransmission failed"
+        await a.close()
+        await b.close()
+
+    asyncio.run(run())
