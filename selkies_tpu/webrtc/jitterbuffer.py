@@ -58,6 +58,13 @@ class JitterBuffer:
             self._next += 1
         return out
 
+    def skip_all(self) -> None:
+        """Abandon every gap up to the highest packet seen (burst-loss
+        resync: the next keyframe restarts decoding)."""
+        self._packets.clear()
+        if self._last_unwrapped >= 0:
+            self._next = self._last_unwrapped + 1
+
     def skip_to(self, seq_u16: int) -> None:
         """Abandon everything before seq (keyframe resync after loss)."""
         seq = unwrap_seq(self._last_unwrapped, seq_u16)

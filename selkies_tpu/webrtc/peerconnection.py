@@ -78,9 +78,11 @@ class MediaSender:
             self.packet_count += 1
             self.octet_count += len(pkt.payload)
             self._sent[pkt.sequence_number] = raw
-            if len(self._sent) > 512:
-                for k in sorted(self._sent)[:256]:
-                    del self._sent[k]
+            while len(self._sent) > 512:
+                # dicts are insertion-ordered: drop the oldest send, which
+                # survives sequence wraparound (a numeric sort would evict
+                # the NEWEST packets right after a wrap)
+                del self._sent[next(iter(self._sent))]
             self.pc._send_rtp(raw)
 
     def resend(self, sequence_numbers) -> int:
@@ -89,7 +91,10 @@ class MediaSender:
         for seq in sequence_numbers:
             raw = self._sent.get(seq & 0xFFFF)
             if raw is not None:
-                self.pc._send_rtp(raw)
+                # no TWCC re-record: the cached packet carries its original
+                # transport seq, and stamping the resend against the live
+                # counter would corrupt the estimator's send-time table
+                self.pc._send_rtp(raw, record_twcc=False)
                 n += 1
         return n
 
@@ -151,6 +156,7 @@ class PeerConnection:
         self._twcc_seq = 0
         self._twcc_sent: Dict[int, Tuple[float, int]] = {}  # seq -> (ms, size)
         self._twcc_recv: Dict[int, int] = {}   # seq -> arrival (µs)
+        self._nacked: Dict[int, float] = {}    # wire seq -> last NACK time
         self._twcc_fb_count = 0
         self._twcc_recv_ssrc = 0
 
@@ -411,8 +417,7 @@ class PeerConnection:
                 if self.on_bitrate:
                     self.on_bitrate(self.gcc.bitrate)
             elif isinstance(pkt, RtcpRemb):
-                self.gcc.loss.bitrate = min(
-                    self.gcc.loss.bitrate, max(150_000, pkt.bitrate))
+                self.gcc.feed_remb(pkt.bitrate)
                 if self.on_bitrate:
                     self.on_bitrate(self.gcc.bitrate)
             elif isinstance(pkt, RtcpNack):
@@ -430,11 +435,12 @@ class PeerConnection:
         if self.sctp is not None:
             self.sctp.receive(data)
 
-    def _send_rtp(self, raw: bytes) -> None:
+    def _send_rtp(self, raw: bytes, record_twcc: bool = True) -> None:
         if self.srtp_tx is None:
             return
-        # record the just-assigned transport seq against the wire size
-        self._record_twcc_send((self._twcc_seq - 1) & 0xFFFF, len(raw))
+        if record_twcc:
+            # record the just-assigned transport seq against the wire size
+            self._record_twcc_send((self._twcc_seq - 1) & 0xFFFF, len(raw))
         try:
             self.ice.send(self.srtp_tx.protect_rtp(raw))
         except ConnectionError:
@@ -462,11 +468,21 @@ class PeerConnection:
         if not missing or len(missing) > 64:   # burst loss → PLI instead
             if missing and recv.last_ssrc:
                 self.request_keyframe(recv.last_ssrc)
-                recv.jitter.skip_to(
-                    (recv.jitter._last_unwrapped + 1) & 0xFFFF)
+                recv.jitter.skip_all()
             return
-        nack = RtcpNack(sender_ssrc=1, media_ssrc=recv.last_ssrc,
-                        lost=missing)
+        # per-seq holdoff: re-NACK only after the retransmission had a
+        # chance to arrive, or duplicates flood exactly when the path hurts
+        now = time.monotonic()
+        due = [s for s in missing
+               if now - self._nacked.get(s, 0.0) > 0.25]
+        if not due:
+            return
+        for s in due:
+            self._nacked[s] = now
+        if len(self._nacked) > 1024:
+            self._nacked = {s: t for s, t in self._nacked.items()
+                            if now - t < 2.0}
+        nack = RtcpNack(sender_ssrc=1, media_ssrc=recv.last_ssrc, lost=due)
         try:
             self.ice.send(self.srtp_tx.protect_rtcp(nack.serialize()))
         except (ConnectionError, ValueError):

@@ -227,6 +227,13 @@ class GccEstimator:
         self.loss.update(fraction_lost)
         return self.bitrate
 
+    def feed_remb(self, bitrate: int) -> int:
+        """Receiver-estimated max bitrate caps the loss-based estimate
+        (it recovers upward by the loss controller's clean-report growth)."""
+        self.loss.bitrate = min(self.loss.bitrate,
+                                max(MIN_BITRATE, int(bitrate)))
+        return self.bitrate
+
     def feed_twcc(self, received: List[Tuple[int, Optional[int]]],
                   send_info: dict) -> int:
         """Sender-side estimation from a TWCC feedback packet: ``received``
