@@ -317,6 +317,22 @@ class DataStreamingServer:
             if up:
                 up.fobj.close()
                 os.unlink(up.path)
+        elif verb == "s" and msg.args:
+            # scale request (reference "s,<scale>"): HiDPI factor → Xft DPI
+            try:
+                scale = min(4.0, max(0.5, float(msg.args[0])))
+                await self._apply_dpi(int(round(96 * scale)))
+            except ValueError:
+                pass
+        elif verb == "SET_NATIVE_CURSOR_RENDERING" and msg.args:
+            # client renders the cursor itself (CSS) vs composited frames;
+            # re-send the last cursor so the toggle takes effect immediately
+            if self.app is not None and self.app.last_cursor_sent:
+                try:
+                    await websocket.send(
+                        "cursor," + json.dumps(self.app.last_cursor_sent))
+                except Exception:
+                    pass
         elif verb == "cmd":
             if self.settings.command_enabled.value and msg.args:
                 await self._run_command(msg.args[0])
