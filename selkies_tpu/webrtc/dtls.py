@@ -412,6 +412,14 @@ class DtlsEndpoint:
         data = slot["data"]
         data[frag_off:frag_off + len(frag)] = frag
         slot["have"] += len(frag)
+        # numbering-convention tolerance: RFC 6347 has each side start its
+        # message_seq at 0, but some stacks continue a single handshake-wide
+        # sequence. If we've processed nothing yet and the peer's first
+        # message arrives above our expected 0, adopt its numbering (the
+        # transcript is unaffected — both sides hash the wire bytes).
+        if self._next_recv_msg_seq == 0 and 0 not in self._frag_buf \
+                and self._frag_buf:
+            self._next_recv_msg_seq = min(self._frag_buf)
         # process in order
         while True:
             slot = self._frag_buf.get(self._next_recv_msg_seq)
