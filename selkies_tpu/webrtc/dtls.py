@@ -414,12 +414,16 @@ class DtlsEndpoint:
         slot["have"] += len(frag)
         # numbering-convention tolerance: RFC 6347 has each side start its
         # message_seq at 0, but some stacks continue a single handshake-wide
-        # sequence. If we've processed nothing yet and the peer's first
-        # message arrives above our expected 0, adopt its numbering (the
-        # transcript is unaffected — both sides hash the wire bytes).
-        if self._next_recv_msg_seq == 0 and 0 not in self._frag_buf \
-                and self._frag_buf:
-            self._next_recv_msg_seq = min(self._frag_buf)
+        # sequence. Adopt the peer's numbering ONLY off its flight-opening
+        # ServerHello (a lost seq-0 message must not shift us: anything
+        # other than a flight opener arriving first just waits for the
+        # retransmission). Transcript hashing is unaffected — both sides
+        # hash the wire bytes as sent.
+        if self.is_client and self._next_recv_msg_seq == 0 \
+                and 0 not in self._frag_buf:
+            lowest = min(self._frag_buf)
+            if self._frag_buf[lowest]["type"] == HT_SERVER_HELLO:
+                self._next_recv_msg_seq = lowest
         # process in order
         while True:
             slot = self._frag_buf.get(self._next_recv_msg_seq)
