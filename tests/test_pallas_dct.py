@@ -1,0 +1,58 @@
+"""Pallas fused DCT+quant+zigzag kernel vs the XLA reference path.
+
+Runs in interpreter mode on the CPU test backend; the same kernel
+compiles for real on TPU (opt-in, see ops/pallas_dct.py)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+def xla_reference(plane, row_recip):
+    import jax.numpy as jnp
+
+    from selkies_tpu.ops.dct import block_dct2, blockify
+    from selkies_tpu.ops.quant import ZIGZAG
+
+    blocks = blockify(jnp.asarray(plane, jnp.float32)) - 128.0
+    coeffs = block_dct2(blocks)                      # [by, bx, 8, 8]
+    q = jnp.round(coeffs * jnp.asarray(row_recip)[:, None])
+    by, bx = q.shape[:2]
+    return np.asarray(jnp.take(q.reshape(by, bx, 64),
+                               jnp.asarray(ZIGZAG), axis=-1))
+
+
+def test_pallas_matches_xla_path():
+    from selkies_tpu.ops.pallas_dct import dct8_quant_zigzag
+    from selkies_tpu.ops.quant import quality_scaled_tables
+
+    rng = np.random.default_rng(0)
+    h, w = 32, 256
+    plane = rng.integers(0, 256, (h, w)).astype(np.float32)
+    ly, _ = quality_scaled_tables(40)
+    py, _ = quality_scaled_tables(90)
+    # distinct table per 8-row band exercises the per-band recip block
+    row_recip = np.stack(
+        [1.0 / (ly if i % 2 == 0 else py) for i in range(h // 8)]
+    ).astype(np.float32)
+
+    got = np.asarray(dct8_quant_zigzag(plane, row_recip, interpret=True))
+    want = xla_reference(plane, row_recip)
+    assert got.shape == want.shape == (h // 8, w // 8, 64)
+    # same math, same rounding: bit-identical up to f32 associativity (the
+    # DCT contractions are reordered) — allow only the rounding boundary
+    assert np.max(np.abs(got - want)) <= 1.0
+    assert (got == want).mean() > 0.999
+
+
+def test_pallas_flat_plane_dc_only():
+    from selkies_tpu.ops.pallas_dct import dct8_quant_zigzag
+    from selkies_tpu.ops.quant import quality_scaled_tables
+
+    plane = np.full((16, 128), 200, np.float32)
+    ly, _ = quality_scaled_tables(50)
+    row_recip = np.stack([1.0 / ly] * 2).astype(np.float32)
+    out = np.asarray(dct8_quant_zigzag(plane, row_recip, interpret=True))
+    assert np.all(out[:, :, 1:] == 0)       # flat block: DC only
+    assert np.all(out[:, :, 0] == out[0, 0, 0])
