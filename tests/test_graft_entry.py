@@ -1,0 +1,27 @@
+"""The driver-facing entry points must work under a hostile ambient env.
+
+Round 1's MULTICHIP artifact failed because ``dryrun_multichip`` inherited
+the wedged ambient TPU plugin; it now isolates itself in a scrubbed child
+interpreter. This test runs it the way the driver does — including with the
+hazard variable present — and asserts it completes.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_survives_ambient_tpu_plugin():
+    env = dict(os.environ)
+    # Simulate the hazard: the sitecustomize TPU-plugin gate is set and the
+    # parent env requests a TPU backend. The entry point must override both.
+    env["PALLAS_AXON_POOL_IPS"] = "203.0.113.1"
+    env.pop("JAX_PLATFORMS", None)
+    code = ("import sys; sys.path.insert(0, %r); "
+            "import __graft_entry__ as g; g.dryrun_multichip(8)" % REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-4000:]
