@@ -143,6 +143,22 @@ def _hs_header(msg_type: int, length: int, msg_seq: int) -> bytes:
         + length.to_bytes(3, "big")
 
 
+def _merge_range(ranges: list, start: int, end: int) -> None:
+    """Insert [start, end) into a sorted list of disjoint ranges, merging."""
+    if end <= start:
+        return
+    out = []
+    for s, e in ranges:
+        if e < start or s > end:
+            out.append((s, e))
+        else:
+            start = min(start, s)
+            end = max(end, e)
+    out.append((start, end))
+    out.sort()
+    ranges[:] = out
+
+
 class _Buffer:
     def __init__(self, data: bytes):
         self.data = data
@@ -408,10 +424,15 @@ class DtlsEndpoint:
             return
         slot = self._frag_buf.setdefault(
             msg_seq, {"type": msg_type, "len": length,
-                      "data": bytearray(length), "have": 0})
+                      "data": bytearray(length), "ranges": []})
+        if frag_off + len(frag) > slot["len"]:
+            return  # fragment exceeds the declared message length
         data = slot["data"]
         data[frag_off:frag_off + len(frag)] = frag
-        slot["have"] += len(frag)
+        # Track received byte *ranges*, not a running count: retransmitted
+        # or overlapping fragments must not double-count and declare the
+        # message complete while holes remain zero-filled.
+        _merge_range(slot["ranges"], frag_off, frag_off + len(frag))
         # numbering-convention tolerance: RFC 6347 has each side start its
         # message_seq at 0, but some stacks continue a single handshake-wide
         # sequence. Adopt the peer's numbering ONLY off its flight-opening
@@ -427,7 +448,8 @@ class DtlsEndpoint:
         # process in order
         while True:
             slot = self._frag_buf.get(self._next_recv_msg_seq)
-            if slot is None or slot["have"] < slot["len"]:
+            if slot is None or \
+                    sum(e - s for s, e in slot["ranges"]) < slot["len"]:
                 return
             del self._frag_buf[self._next_recv_msg_seq]
             self._next_recv_msg_seq += 1

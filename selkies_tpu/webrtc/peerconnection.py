@@ -227,7 +227,15 @@ class PeerConnection:
         self._remote_desc = SessionDescription.parse(sdp)
         media = self._remote_desc.media
         if not media:
+            self._remote_desc = None
             raise ValueError("no media sections")
+        if not any(m.dtls_fingerprint for m in media):
+            # Fail closed up front (also re-checked in _start_transport):
+            # an unpinned DTLS handshake would be open to on-path MITM.
+            self._remote_desc = None
+            raise ValueError(
+                "remote description carries no DTLS fingerprint "
+                "(session- or media-level a=fingerprint required)")
         m0 = media[0]
         if self.ice is not None:
             if m0.ice_ufrag and m0.ice_pwd:
@@ -300,8 +308,16 @@ class PeerConnection:
             media=media, bundle=mids)
 
     def _start_transport(self) -> None:
-        remote_m0 = self._remote_desc.media[0]
-        remote_fp = remote_m0.dtls_fingerprint
+        remote_fp = next(
+            (m.dtls_fingerprint for m in self._remote_desc.media
+             if m.dtls_fingerprint), None)
+        if remote_fp is None:
+            # Fail closed: without a pinned fingerprint the DTLS layer
+            # would complete unauthenticated, opening media and the input
+            # data channel to an on-path MITM.
+            raise ValueError(
+                "remote description carries no DTLS fingerprint "
+                "(session- or media-level a=fingerprint required)")
         # offerer offered actpass; answerer is active (DTLS client)
         is_dtls_client = not self.is_offerer
         self.dtls = DtlsEndpoint(
@@ -395,9 +411,10 @@ class PeerConnection:
 
     def _record_twcc_send(self, seq: int, size: int) -> None:
         self._twcc_sent[seq] = (time.monotonic() * 1000.0, size)
-        if len(self._twcc_sent) > TWCC_HISTORY:
-            for k in sorted(self._twcc_sent)[:len(self._twcc_sent) // 2]:
-                del self._twcc_sent[k]
+        # Evict in insertion order (dicts preserve it): numeric order would
+        # drop the *newest* entries right after the 16-bit seq wrap.
+        while len(self._twcc_sent) > TWCC_HISTORY:
+            del self._twcc_sent[next(iter(self._twcc_sent))]
 
     def _handle_rtcp(self, data: bytes) -> None:
         try:

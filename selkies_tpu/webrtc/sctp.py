@@ -103,6 +103,11 @@ def tsn_gt(a: int, b: int) -> bool:
     return ((a - b) & 0xFFFFFFFF) < 0x80000000 and a != b
 
 
+def ssn_gt(a: int, b: int) -> bool:
+    """16-bit serial comparison for stream sequence numbers."""
+    return ((a - b) & 0xFFFF) < 0x8000 and a != b
+
+
 @dataclass
 class DataChannel:
     stream_id: int
@@ -147,7 +152,13 @@ class SctpAssociation:
         self.on_channel: Optional[Callable[[DataChannel], None]] = None
 
         self._ssn: Dict[int, int] = {}
+        self._next_ssn: Dict[int, int] = {}     # sid -> next expected SSN
+        self._ordered_hold: Dict[int, Dict[int, Tuple[int, bytes]]] = {}
         self._reasm: Dict[Tuple[int, int], List] = {}
+        # unordered fragments reassemble by TSN adjacency, not SSN: senders
+        # commonly stamp every unordered message SSN 0, so (sid, ssn) would
+        # collide across messages
+        self._u_reasm: Dict[int, Dict[int, Tuple[bool, bool, int, bytes]]] = {}
         self._out: Dict[int, _OutChunk] = {}
         self._recv_tsns: set = set()
         self._next_even_odd = 0 if is_client else 1
@@ -263,6 +274,8 @@ class SctpAssociation:
             elif ctype == CT_SHUTDOWN_ACK:
                 self._send_packet([self._chunk(CT_SHUTDOWN_COMPLETE, 0, b"")])
                 self.state = "closed"
+            elif ctype == CT_FORWARD_TSN:
+                self._on_forward_tsn(body)
         if sacked:
             self._send_sack()
 
@@ -353,10 +366,15 @@ class SctpAssociation:
         while ((self.cum_ack + 1) & 0xFFFFFFFF) in self._recv_tsns:
             self.cum_ack = (self.cum_ack + 1) & 0xFFFFFFFF
         begin, end = flags & 0x02, flags & 0x01
-        key = (sid, ssn)
+        unordered = bool(flags & 0x04)
         if begin and end:
-            self._deliver(sid, ppid, payload)
+            self._deliver_complete(sid, ssn, ppid, payload, unordered)
+        elif unordered:
+            ufrags = self._u_reasm.setdefault(sid, {})
+            ufrags[tsn] = (bool(begin), bool(end), ppid, payload)
+            self._try_unordered_reasm(sid, tsn)
         else:
+            key = (sid, ssn)
             frags = self._reasm.setdefault(key, [])
             frags.append((tsn, begin, end, payload))
             # serial sort robust to the 32-bit wrap: all fragments of one
@@ -369,7 +387,129 @@ class SctpAssociation:
                         for i in range(len(frags) - 1)):
                 whole = b"".join(f[3] for f in frags)
                 del self._reasm[key]
-                self._deliver(sid, ppid, whole)
+                self._deliver_complete(sid, ssn, ppid, whole, unordered)
+
+    def _try_unordered_reasm(self, sid: int, tsn: int) -> None:
+        """Assemble an unordered message around ``tsn`` by TSN adjacency
+        (RFC 4960 §6.6: unordered fragments of one message occupy
+        consecutive TSNs from the B fragment to the E fragment)."""
+        ufrags = self._u_reasm[sid]
+        start = tsn
+        while True:
+            f = ufrags.get(start)
+            if f is None:
+                return
+            if f[0]:        # B fragment
+                break
+            start = (start - 1) & 0xFFFFFFFF
+        stop = tsn
+        while True:
+            f = ufrags.get(stop)
+            if f is None:
+                return
+            if f[1]:        # E fragment
+                break
+            stop = (stop + 1) & 0xFFFFFFFF
+        run = []
+        t = start
+        while True:
+            run.append(t)
+            if t == stop:
+                break
+            t = (t + 1) & 0xFFFFFFFF
+        ppid = ufrags[start][2]
+        whole = b"".join(ufrags[t][3] for t in run)
+        for t in run:
+            del ufrags[t]
+        self._deliver(sid, ppid, whole)
+
+    def _on_forward_tsn(self, body: bytes) -> None:
+        """RFC 3758: the peer abandoned chunks up to a new cumulative TSN.
+
+        Advance the receive state so ordered streams do not hold back
+        forever behind an abandoned SSN."""
+        if len(body) < 4:
+            return
+        new_cum = struct.unpack_from("!I", body)[0]
+        if not tsn_gt(new_cum, self.cum_ack):
+            return
+        self.cum_ack = new_cum
+        self._seen_first = True
+        # continue over anything contiguous we already hold
+        while ((self.cum_ack + 1) & 0xFFFFFFFF) in self._recv_tsns:
+            self.cum_ack = (self.cum_ack + 1) & 0xFFFFFFFF
+        pos = 4
+        while pos + 4 <= len(body):
+            sid, ssn = struct.unpack_from("!HH", body, pos)
+            pos += 4
+            old = self._next_ssn.setdefault(sid, 0)
+            new_next = (ssn + 1) & 0xFFFF
+            hold = self._ordered_hold.get(sid, {})
+            if ssn_gt(new_next, old):
+                # the skip unblocks fully received messages queued at or
+                # below the abandoned SSN — deliver them, don't drop them
+                for s in sorted(hold, key=lambda s: (s - old) & 0xFFFF):
+                    if ssn_gt(s, ssn):
+                        continue
+                    item = hold.pop(s)
+                    self._deliver(sid, item[0], item[1])
+                self._next_ssn[sid] = new_next
+            # drop reassembly state for abandoned messages on this stream
+            for key in [k for k in self._reasm
+                        if k[0] == sid and not ssn_gt(k[1], ssn)]:
+                del self._reasm[key]
+            # release anything now contiguous past the skip
+            while True:
+                nxt = self._next_ssn[sid]
+                item = hold.pop(nxt, None)
+                if item is None:
+                    break
+                self._next_ssn[sid] = (nxt + 1) & 0xFFFF
+                self._deliver(sid, item[0], item[1])
+        self._prune_unordered_reasm(new_cum)
+        self._send_sack()
+
+    def _prune_unordered_reasm(self, cum: int) -> None:
+        """Unordered fragments of messages abandoned by a FORWARD TSN can
+        never complete (TSNs at/below cum are dropped on arrival) — free
+        them instead of leaking per-connection memory."""
+        for ufrags in self._u_reasm.values():
+            for t in [t for t in ufrags if not tsn_gt(t, cum)]:
+                del ufrags[t]
+            # cascade upward: a non-B fragment at boundary+1 whose
+            # predecessor was abandoned can never reach its B fragment
+            boundary = cum
+            for t in sorted(ufrags, key=lambda x: (x - cum) & 0xFFFFFFFF):
+                prev = (t - 1) & 0xFFFFFFFF
+                if not ufrags[t][0] and prev not in ufrags \
+                        and not tsn_gt(prev, boundary):
+                    del ufrags[t]
+                    boundary = t
+
+    def _deliver_complete(self, sid: int, ssn: int, ppid: int,
+                          payload: bytes, unordered: bool) -> None:
+        """Deliver a fully reassembled message, honoring stream ordering.
+
+        Ordered streams (the "input" data channel is opened ordered) must
+        not surface messages in TSN-completion order under UDP reordering —
+        e.g. keyup before keydown. Hold out-of-order messages per stream
+        and release them in SSN sequence.
+        """
+        if unordered:
+            self._deliver(sid, ppid, payload)
+            return
+        nxt = self._next_ssn.setdefault(sid, 0)
+        if ssn != nxt and not ssn_gt(ssn, nxt):
+            return  # stale duplicate of an already-delivered SSN
+        hold = self._ordered_hold.setdefault(sid, {})
+        hold[ssn] = (ppid, payload)
+        while True:
+            nxt = self._next_ssn[sid]
+            item = hold.pop(nxt, None)
+            if item is None:
+                return
+            self._next_ssn[sid] = (nxt + 1) & 0xFFFF
+            self._deliver(sid, item[0], item[1])
 
     def _send_sack(self) -> None:
         gaps = b""
@@ -390,6 +530,11 @@ class SctpAssociation:
         if start is not None:
             blocks.append((start, end))
         for s, e in blocks[:20]:
+            if e > 0xFFFF:
+                # gap-block offsets are 16-bit; anything further ahead is
+                # left for the peer's RTX timer rather than raising
+                # struct.error out of the receive path
+                continue
             gaps += struct.pack("!HH", s, e)
             n_gaps += 1
         body = struct.pack("!IIHH", self.cum_ack, self.a_rwnd, n_gaps, 0) + gaps

@@ -129,6 +129,11 @@ class SessionDescription:
     def parse(cls, text: str) -> "SessionDescription":
         desc = cls(media=[])
         current: Optional[MediaSection] = None
+        # Session-level attributes (before the first m= line) are defaults
+        # for every media section — Firefox in particular puts
+        # a=fingerprint at session level, and dropping it would leave the
+        # DTLS layer with no fingerprint to pin.
+        session = MediaSection(kind="session", codecs=[])
         for raw in text.replace("\r\n", "\n").split("\n"):
             line = raw.strip()
             if not line:
@@ -144,7 +149,18 @@ class SessionDescription:
                                        protocol=parts[2], codecs=[])
                 desc.media.append(current)
             elif line.startswith("a="):
-                desc._attr(current, line[2:])
+                desc._attr(current if current is not None else session,
+                           line[2:])
+        for m in desc.media:
+            if m.ice_ufrag is None:
+                m.ice_ufrag = session.ice_ufrag
+            if m.ice_pwd is None:
+                m.ice_pwd = session.ice_pwd
+            if m.dtls_fingerprint is None:
+                m.dtls_fingerprint = session.dtls_fingerprint
+            if m.dtls_setup is None:
+                m.dtls_setup = session.dtls_setup
+            m.ice_lite = m.ice_lite or session.ice_lite
         return desc
 
     def _attr(self, m: Optional[MediaSection], attr: str) -> None:

@@ -192,3 +192,30 @@ def test_dtls_srtp_end_to_end():
     back = RtpPacket(payload_type=111, sequence_number=1, ssrc=2,
                      payload=b"opus" * 40).serialize()
     assert c_rx.unprotect_rtp(s_tx.protect_rtp(back)) == back
+
+
+def test_merge_range_overlaps():
+    from selkies_tpu.webrtc.dtls import _merge_range
+    r = []
+    _merge_range(r, 0, 10)
+    _merge_range(r, 0, 10)          # exact retransmit: no double count
+    assert r == [(0, 10)]
+    _merge_range(r, 20, 30)
+    assert r == [(0, 10), (20, 30)]
+    _merge_range(r, 5, 25)          # bridge the hole
+    assert r == [(0, 30)]
+    assert sum(e - s for s, e in r) == 30
+
+
+def test_retransmitted_fragment_does_not_complete_early():
+    from selkies_tpu.webrtc.dtls import DtlsEndpoint
+    ep = DtlsEndpoint(is_client=False)
+    seq = ep._next_recv_msg_seq
+    # 20-byte handshake message, first half arrives twice (retransmit);
+    # byte-counting would declare it complete with a zero-filled tail
+    ep._feed_fragment(1, 20, seq, 0, b"A" * 10)
+    ep._feed_fragment(1, 20, seq, 0, b"A" * 10)
+    assert seq in ep._frag_buf          # still incomplete
+    assert ep.handshake_failed is None  # no corrupted-transcript attempt
+    ep._feed_fragment(1, 20, seq, 10, b"B" * 10)
+    assert seq not in ep._frag_buf      # now processed (and consumed)

@@ -162,3 +162,28 @@ def test_sdp_parses_browser_style_offer():
     assert m.codecs[1].fmtp == "packetization-mode=1"
     assert m.candidates[0].host == "192.168.1.4"
     assert m.dtls_setup == "active"
+
+
+def test_sdp_session_level_attributes_apply_to_media():
+    # Firefox places fingerprint/ice credentials at session level; they
+    # must flow down to every media section as defaults.
+    text = (
+        "v=0\r\no=- 88 2 IN IP4 127.0.0.1\r\ns=-\r\nt=0 0\r\n"
+        "a=fingerprint:sha-256 AA:BB:CC\r\n"
+        "a=ice-ufrag:sess-uf\r\na=ice-pwd:sess-pw\r\n"
+        "a=setup:actpass\r\n"
+        "a=group:BUNDLE 0 1\r\n"
+        "m=video 9 UDP/TLS/RTP/SAVPF 96\r\n"
+        "a=mid:0\r\na=rtpmap:96 H264/90000\r\n"
+        "m=audio 9 UDP/TLS/RTP/SAVPF 111\r\n"
+        "a=mid:1\r\na=ice-ufrag:media-uf\r\n"
+        "a=rtpmap:111 opus/48000/2\r\n")
+    got = SessionDescription.parse(text)
+    assert got.bundle == ["0", "1"]
+    for m in got.media:
+        assert m.dtls_fingerprint == "sha-256 AA:BB:CC"
+        assert m.dtls_setup == "actpass"
+        assert m.ice_pwd == "sess-pw"
+    # media-level values win over session defaults
+    assert got.media[0].ice_ufrag == "sess-uf"
+    assert got.media[1].ice_ufrag == "media-uf"

@@ -164,3 +164,33 @@ def test_nack_retransmission_recovers_loss():
         await b.close()
 
     asyncio.run(run())
+
+
+def test_missing_fingerprint_fails_closed():
+    async def run():
+        offerer = PeerConnection(interfaces=["127.0.0.1"])
+        answerer = PeerConnection(interfaces=["127.0.0.1"])
+        offerer.add_video_sender(ssrc=0x1111)
+        offerer.create_data_channel("input")
+        offer = await offerer.create_offer()
+        stripped = "\r\n".join(
+            line for line in offer.split("\r\n")
+            if not line.startswith("a=fingerprint"))
+        with pytest.raises(ValueError, match="fingerprint"):
+            await answerer.set_remote_description(stripped, "offer")
+        await offerer.close()
+        await answerer.close()
+    asyncio.run(run())
+
+
+def test_twcc_eviction_keeps_newest_across_wrap():
+    from selkies_tpu.webrtc import peerconnection as pcmod
+    pc = pcmod.PeerConnection.__new__(pcmod.PeerConnection)
+    pc._twcc_sent = {}
+    seqs = [i & 0xFFFF for i in range(65000, 65000 + 3000)]  # crosses wrap
+    for s in seqs:
+        pc._record_twcc_send(s, 1200)
+    # the survivors must be the newest TWCC_HISTORY records in send order,
+    # not the numerically largest (which right after the wrap would evict
+    # the newest, stalling the GCC estimator)
+    assert list(pc._twcc_sent) == seqs[-pcmod.TWCC_HISTORY:]

@@ -122,7 +122,8 @@ def test_retransmission_under_loss():
     for i in range(20):
         a.send(ch, b"msg-%d" % i)
     pump(a, b, qa, qb, drop=0.2, iters=4000)
-    assert set(got) == {b"msg-%d" % i for i in range(20)}
+    # ordered channel: exact send order must survive loss + retransmission
+    assert got == [b"msg-%d" % i for i in range(20)]
 
 
 def test_sctp_over_dtls():
@@ -167,3 +168,170 @@ def test_sctp_over_dtls():
         while so:
             client.receive(so.pop(0))
     assert got == [b"m,100,200,0,0"]
+
+
+def test_ordered_delivery_under_reordering():
+    a, b, qa, qb = make_pair()
+    b.start()
+    a.start()
+    pump(a, b, qa, qb)
+    ch = a.create_channel("input")   # ordered (default)
+    pump(a, b, qa, qb)
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+    a.send(ch, b"kd,65")
+    a.send(ch, b"ku,65")
+    a.send(ch, b"kd,66")
+    packets = [qa.pop(0) for _ in range(len(qa))]
+    for p in reversed(packets):      # worst-case UDP reordering
+        b.receive(p)
+    assert got == [b"kd,65", b"ku,65", b"kd,66"]
+
+
+def test_unordered_channel_delivers_immediately():
+    a, b, qa, qb = make_pair()
+    b.start()
+    a.start()
+    pump(a, b, qa, qb)
+    ch = a.create_channel("stats", ordered=False)
+    pump(a, b, qa, qb)
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+    a.send(ch, b"one")
+    a.send(ch, b"two")
+    packets = [qa.pop(0) for _ in range(len(qa))]
+    for p in reversed(packets):
+        b.receive(p)
+    # unordered: surfaced in arrival order, no holdback
+    assert sorted(got) == [b"one", b"two"]
+    assert got == [b"two", b"one"]
+
+
+def test_sack_gap_beyond_u16_does_not_raise():
+    a, b, qa, qb = make_pair()
+    b.start()
+    a.start()
+    pump(a, b, qa, qb)
+    ch = a.create_channel("jumpy")
+    pump(a, b, qa, qb)
+    # a TSN far (>65535) ahead of b's cumulative ack must not blow up
+    # b's SACK encoding (struct 'H' overflow) in the receive path
+    a.next_tsn = (a.next_tsn + 0x20000) & 0xFFFFFFFF
+    a.send(ch, b"far-future")
+    while qa:
+        b.receive(qa.pop(0))
+    while qb:
+        a.receive(qb.pop(0))
+
+
+def test_unordered_fragmented_interleaved():
+    a, b, qa, qb = make_pair()
+    b.start()
+    a.start()
+    pump(a, b, qa, qb)
+    ch = a.create_channel("bulk", ordered=False)
+    pump(a, b, qa, qb)
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+    m1 = b"X" * 1500   # 2 fragments each; unordered messages all carry
+    m2 = b"Y" * 1500   # the same SSN, so reassembly must key on TSN runs
+    a.send(ch, m1)
+    a.send(ch, m2)
+    pkts = [qa.pop(0) for _ in range(len(qa))]
+    assert len(pkts) == 4
+    b.receive(pkts[0])   # B1
+    b.receive(pkts[2])   # B2 (interleaved)
+    b.receive(pkts[1])   # E1
+    b.receive(pkts[3])   # E2
+    assert got == [m1, m2]
+
+
+def test_forward_tsn_unblocks_ordered_hold():
+    import struct as _s
+    from selkies_tpu.webrtc.sctp import CT_FORWARD_TSN
+    a, b, qa, qb = make_pair()
+    b.start()
+    a.start()
+    pump(a, b, qa, qb)
+    ch = a.create_channel("input")
+    pump(a, b, qa, qb)
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+
+    lost_tsn = a.next_tsn
+    lost_ssn = a._ssn.get(ch.stream_id, 0)   # DCEP OPEN consumed ssn 0
+    a.send(ch, b"lost")      # this packet will be dropped
+    qa.pop(0)
+    a.send(ch, b"held")      # arrives, must be held back
+    b.receive(qa.pop(0))
+    assert got == []         # ordered: held behind the lost ssn
+
+    # peer abandons the lost chunk (RFC 3758 FORWARD TSN)
+    body = _s.pack("!IHH", lost_tsn, ch.stream_id, lost_ssn)
+    a._send_packet([a._chunk(CT_FORWARD_TSN, 0, body)])
+    while qa:
+        b.receive(qa.pop(0))
+    assert got == [b"held"]  # hold released, stream alive
+    a._out.clear()           # the abandoned chunk is no longer our problem
+    got_after = []
+    b.channels[ch.stream_id].on_message = lambda m: got_after.append(m)
+    a.send(ch, b"next")
+    pump(a, b, qa, qb)
+    assert got_after == [b"next"]
+
+
+def test_forward_tsn_delivers_skipped_over_hold():
+    import struct as _s
+    from selkies_tpu.webrtc.sctp import CT_FORWARD_TSN
+    a, b, qa, qb = make_pair()
+    b.start()
+    a.start()
+    pump(a, b, qa, qb)
+    ch = a.create_channel("input")
+    pump(a, b, qa, qb)
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+
+    base_ssn = a._ssn.get(ch.stream_id, 0)
+    tsns, pkts = [], []
+    for m in (b"s0-lost", b"s1-held", b"s2-held", b"s3-lost"):
+        tsns.append(a.next_tsn)
+        a.send(ch, m)
+        pkts.append(qa.pop(0))
+    b.receive(pkts[1])
+    b.receive(pkts[2])
+    assert got == []     # both held behind the lost first message
+
+    # abandon BOTH lost messages in one FORWARD TSN listing the last ssn;
+    # the fully received middle messages must be delivered, not dropped
+    body = _s.pack("!IHH", tsns[3], ch.stream_id, (base_ssn + 3) & 0xFFFF)
+    a._send_packet([a._chunk(CT_FORWARD_TSN, 0, body)])
+    while qa:
+        b.receive(qa.pop(0))
+    assert got == [b"s1-held", b"s2-held"]
+
+
+def test_forward_tsn_prunes_unordered_fragments():
+    import struct as _s
+    from selkies_tpu.webrtc.sctp import CT_FORWARD_TSN
+    a, b, qa, qb = make_pair()
+    b.start()
+    a.start()
+    pump(a, b, qa, qb)
+    ch = a.create_channel("bulk", ordered=False)
+    pump(a, b, qa, qb)
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+
+    last_tsn = a.next_tsn + 1           # E fragment's TSN
+    a.send(ch, b"Z" * 1500)             # 2 fragments
+    qa.pop(0)                           # B fragment lost
+    b.receive(qa.pop(0))                # E fragment arrives, buffered
+    assert b._u_reasm[ch.stream_id]
+
+    body = _s.pack("!IHH", last_tsn, 0xFFFF, 0)  # no affected ordered stream
+    a._send_packet([a._chunk(CT_FORWARD_TSN, 0, body)])
+    while qa:
+        b.receive(qa.pop(0))
+    assert not b._u_reasm[ch.stream_id]  # abandoned fragments freed
+    assert got == []
