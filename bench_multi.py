@@ -1,17 +1,17 @@
 """Multi-session encode benchmark (BASELINE config 5, single-chip slice).
 
-Measures aggregate 1080p encode throughput with N independent desktop
-sessions time-sharing ONE chip — the realistic single-chip serving mode:
-each session runs its own pipelined encoder (own damage state, own
-bitstreams) and the round-robin scheduler keeps the device queue full.
-Cross-chip scaling of the same step (sessions data-parallel, stripes
-spatially sharded, psum rate feedback) lives in selkies_tpu.parallel and
-is validated by __graft_entry__.dryrun_multichip on a virtual mesh; real
-aggregate numbers on a v5e-8 slice are expected to scale with chips since
-sessions are embarrassingly parallel across the "session" axis.
+Measures aggregate 1080p encode throughput with N desktop sessions on the
+available devices, two ways:
 
-Prints ONE JSON line:
-  {"metric": "tpuenc_jpeg_multisession_aggregate_fps", ...}
+  1. time-shared: each session runs its own pipelined solo encoder and the
+     round-robin scheduler keeps the device queue full (round-1 mode);
+  2. mesh-batched: every session's frame rides ONE sharded
+     MeshStripeEncoder dispatch (the tpu_mesh product path) — on a
+     multi-chip slice sessions are data-parallel over the "session" mesh
+     axis; on one chip the batch amortizes per-dispatch overhead.
+
+Prints ONE JSON line with the better aggregate as the headline value and
+both breakdowns.
 """
 
 from __future__ import annotations
@@ -25,6 +25,68 @@ W, H = 1920, 1080
 WARMUP_FRAMES = 24
 BENCH_FRAMES = 400           # across all sessions
 MAX_SECONDS = 90.0
+
+
+def bench_mesh() -> dict:
+    """Mesh-batched aggregate: one sharded dispatch per tick for all N."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from selkies_tpu.parallel import Mesh, MeshStripeEncoder
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = Mesh(np.asarray(devices).reshape(n_dev, 1), ("session", "stripe"))
+    per_chip = max(1, N_SESSIONS // n_dev)
+    n_sessions = per_chip * n_dev
+    enc = MeshStripeEncoder(mesh, n_sessions, W, H)
+
+    # device-resident scrolling batch: full damage every tick, no H2D cost,
+    # same "scroll" content as the solo bench (noise would quadruple the
+    # bitstream and measure the D2H link instead of the encoder)
+    from selkies_tpu.capture.synthetic import SyntheticSource
+
+    base = np.stack([
+        np.pad(SyntheticSource(W, H, pattern="scroll", seed=i)._bg,
+               ((0, enc.pad_h - H), (0, enc.pad_w - W), (0, 0)), mode="edge")
+        for i in range(n_sessions)])
+    batch = jnp.asarray(base)
+    roll = jax.jit(lambda b: jnp.roll(b, -8, axis=1))
+
+    for _ in range(3):
+        batch = roll(batch)
+        enc.encode_frames(batch)
+
+    frames = 0
+    total_bytes = 0
+    ticks = max(1, BENCH_FRAMES // n_sessions)
+    from collections import deque
+
+    start = time.perf_counter()
+    pending = deque()
+    for _ in range(ticks):
+        if time.perf_counter() - start > MAX_SECONDS / 2:
+            break
+        batch = roll(batch)
+        pending.append(enc.dispatch(batch))  # overlap: 2 steps in flight
+        if len(pending) >= 3:
+            out, _bytes = enc.harvest(pending.popleft())
+            frames += sum(1 for s in out if s)
+            total_bytes += sum(len(st.jpeg) for s in out for st in s)
+    while pending:
+        out, _bytes = enc.harvest(pending.popleft())
+        frames += sum(1 for s in out if s)
+        total_bytes += sum(len(st.jpeg) for s in out for st in s)
+    elapsed = time.perf_counter() - start
+    fps = frames / elapsed if elapsed > 0 else 0.0
+    return {
+        "mesh_aggregate_fps": round(fps, 2),
+        "mesh_sessions": n_sessions,
+        "mesh_devices": n_dev,
+        "mesh_frames": frames,
+        "mesh_mean_frame_kb": round(total_bytes / max(frames, 1) / 1024, 1),
+    }
 
 
 def main() -> None:
@@ -76,16 +138,29 @@ def main() -> None:
     elapsed = time.perf_counter() - start
 
     fps = done / elapsed if elapsed > 0 else 0.0
+    mesh = bench_mesh()
+    # headline: the better mode, with per-session figures computed against
+    # THAT mode's session count (mesh may batch more sessions on big slices)
+    if mesh["mesh_aggregate_fps"] > fps:
+        best, best_sessions = mesh["mesh_aggregate_fps"], mesh["mesh_sessions"]
+        mode = "mesh"
+    else:
+        best, best_sessions = fps, N_SESSIONS
+        mode = "solo"
     print(json.dumps({
         "metric": "tpuenc_jpeg_multisession_aggregate_fps",
-        "value": round(fps, 2),
+        "value": round(best, 2),
         "unit": "fps",
-        "sessions": N_SESSIONS,
-        "per_session_fps": round(fps / N_SESSIONS, 2),
-        "vs_baseline": round(fps / (60.0 * N_SESSIONS), 3),
-        "frames": done,
+        "mode": mode,
+        "sessions": best_sessions,
+        "per_session_fps": round(best / best_sessions, 2),
+        "vs_baseline": round(best / (60.0 * best_sessions), 3),
+        "solo_sessions": N_SESSIONS,
+        "solo_aggregate_fps": round(fps, 2),
+        "solo_frames": done,
         "elapsed_s": round(elapsed, 2),
         "mean_frame_kb": round(total_bytes / max(done, 1) / 1024, 1),
+        **mesh,
     }))
 
 

@@ -14,6 +14,23 @@ the stripe axis (a session's stripes live on different chips) and globally
 over the session axis to drive the shared rate controller.
 """
 
-from .mesh import make_mesh, make_batched_step, BatchedSessionEncoder
+from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "make_batched_step", "BatchedSessionEncoder"]
+from .mesh import (
+    BatchedSessionEncoder,
+    MeshStripeEncoder,
+    make_batched_entropy_step,
+    make_batched_step,
+    make_mesh,
+    parse_mesh_spec,
+)
+
+__all__ = [
+    "Mesh",
+    "make_mesh",
+    "parse_mesh_spec",
+    "make_batched_step",
+    "make_batched_entropy_step",
+    "BatchedSessionEncoder",
+    "MeshStripeEncoder",
+]

@@ -1,0 +1,244 @@
+"""Mesh encode coordinator: N live sessions → one sharded dispatch per tick.
+
+This is the integration layer that makes BASELINE config 5 a *product*
+path rather than a benchmark: the server's per-display capture loops keep
+their shape (one asyncio task per display, reference selkies.py:2846-2904),
+but instead of each owning a solo encoder pipeline they submit frames to a
+per-session facade, and a single worker thread batches every session's
+latest frame into one :class:`~selkies_tpu.parallel.mesh.MeshStripeEncoder`
+dispatch over the ("session", "stripe") device mesh.
+
+Facades expose the PipelinedJpegEncoder surface the capture loop already
+speaks (``try_submit`` / ``poll`` / ``flush`` / ``force_keyframe`` /
+``close``), so the server code path is identical either way.
+
+Scheduling model: the worker ticks at the configured framerate. A tick
+encodes the newest submitted frame per attached session; sessions without
+a new frame re-present their previous frame, which damage gating then
+suppresses on device — the dispatch stays dense and mesh-uniform (SPMD
+needs every device to run the same program) while idle sessions cost no
+wire bytes. Mesh batching uses the server-wide quality settings; per-client
+encoder overrides are ignored in this mode (they would break SPMD
+uniformity), which mirrors the shared-pipeline restriction the reference
+has for shared displays.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("selkies_tpu.parallel")
+
+
+class MeshSessionFacade:
+    """One session's encoder-shaped handle onto the coordinator."""
+
+    def __init__(self, coord: "MeshEncodeCoordinator", slot: int) -> None:
+        self._coord = coord
+        self.slot = slot
+        self.closed = False
+
+    def try_submit(self, frame) -> Optional[int]:
+        return self._coord._submit(self.slot, frame)
+
+    submit = try_submit
+
+    def poll(self) -> List[Tuple[int, list]]:
+        return self._coord._poll(self.slot)
+
+    def flush(self) -> List[Tuple[int, list]]:
+        return self._coord._flush(self.slot)
+
+    def force_keyframe(self) -> None:
+        self._coord._force_keyframe(self.slot)
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._coord._release(self.slot)
+
+
+class MeshEncodeCoordinator:
+    """Owns the mesh encoder, the session slot table, and the tick thread."""
+
+    def __init__(
+        self,
+        mesh_spec: str,
+        sessions_per_chip: int,
+        width: int,
+        height: int,
+        settings=None,
+        framerate: float = 60.0,
+        stripe_h: int = 64,
+    ) -> None:
+        from .mesh import MeshStripeEncoder, parse_mesh_spec
+
+        self.mesh = parse_mesh_spec(mesh_spec)
+        n_sessions = self.mesh.shape["session"] * max(1, sessions_per_chip)
+        kwargs: Dict[str, Any] = {}
+        if settings is not None:
+            kwargs = dict(
+                quality=int(settings.jpeg_quality.default),
+                paintover_quality=int(
+                    settings.paint_over_jpeg_quality.default),
+                use_paint_over_quality=bool(
+                    settings.use_paint_over_quality.value),
+                stripe_h=int(settings.tpu_stripe_height),
+            )
+        else:
+            kwargs = dict(stripe_h=stripe_h)
+        self.enc = MeshStripeEncoder(
+            self.mesh, n_sessions, width, height, **kwargs)
+        self.width, self.height = width, height
+        self.framerate = float(framerate)
+        self.n_sessions = n_sessions
+
+        self._lock = threading.Lock()
+        self._free = list(range(n_sessions))
+        self._attached: Dict[int, bool] = {}
+        self._pending: Dict[int, Any] = {}       # slot -> newest frame
+        self._results: Dict[int, List] = {}      # slot -> [(seq, stripes)]
+        self._seq: Dict[int, int] = {}
+        self._want_key: set = set()
+        self._inflight: Tuple[Optional[Any], List[int]] = (None, [])
+        self._inflight_slots: set = set()
+        self._kick = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: total coded bytes per slot from the device rate feedback
+        self.coded_bytes = [0] * n_sessions
+        #: bumped on every acquire: harvests tagged with an older generation
+        #: are dropped so a reused slot never receives the previous
+        #: occupant's pixels (results dispatched before the handover)
+        self._gen = [0] * n_sessions
+
+    # -- session lifecycle (event-loop side) -------------------------------
+
+    def acquire(self, width: int, height: int) -> Optional[MeshSessionFacade]:
+        """Attach a session; None when geometry differs or slots are full."""
+        if (width, height) != (self.width, self.height):
+            return None
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop(0)
+            self._gen[slot] += 1
+            self._attached[slot] = True
+            self._results[slot] = []
+            self._seq[slot] = 0
+            # applied at tick time: the worker may be mid-dispatch and the
+            # encoder's host state is not safe to touch from here
+            self._want_key.add(slot)
+        self._ensure_thread()
+        return MeshSessionFacade(self, slot)
+
+    def _release(self, slot: int) -> None:
+        with self._lock:
+            self._attached.pop(slot, None)
+            self._pending.pop(slot, None)
+            self._results.pop(slot, None)
+            self._free.append(slot)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- facade surface ----------------------------------------------------
+
+    def _submit(self, slot: int, frame) -> Optional[int]:
+        with self._lock:
+            if slot not in self._attached:
+                return None
+            dropped = slot in self._pending
+            self._pending[slot] = frame
+            seq = self._seq[slot]
+        self._kick.set()
+        return None if dropped else seq
+
+    def _poll(self, slot: int) -> List[Tuple[int, list]]:
+        with self._lock:
+            out = self._results.get(slot, [])
+            if out:
+                self._results[slot] = []
+            return out
+
+    def _flush(self, slot: int) -> List[Tuple[int, list]]:
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if slot not in self._pending and \
+                        slot not in self._inflight_slots:
+                    break
+            time.sleep(0.005)
+        return self._poll(slot)
+
+    def _force_keyframe(self, slot: int) -> None:
+        with self._lock:
+            self._want_key.add(slot)
+        self._kick.set()
+
+    # -- worker ------------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="mesh-encode", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        interval = 1.0 / max(1.0, self.framerate)
+        next_tick = time.monotonic()
+        while not self._stop.is_set():
+            delay = next_tick - time.monotonic()
+            if delay > 0:
+                self._kick.wait(timeout=delay)
+            self._kick.clear()
+            now = time.monotonic()
+            if now < next_tick:
+                continue
+            next_tick = max(next_tick + interval, now - interval)
+            try:
+                self._tick()
+            except Exception:
+                logger.exception("mesh encode tick failed")
+                time.sleep(0.5)
+
+    def _tick(self) -> None:
+        """Dispatch this tick's frames, then harvest the *previous* tick's
+        dispatch — one step stays in flight so the device round trip is
+        hidden behind the next tick's work (depth-1 pipeline, same idea
+        as PipelinedJpegEncoder)."""
+        with self._lock:
+            for slot in self._want_key:
+                if slot in self._attached or slot in self._free:
+                    self.enc.force_keyframe(slot)
+            self._want_key.clear()
+            frames = [None] * self.n_sessions
+            took: List[Tuple[int, int]] = []   # (slot, generation)
+            for slot in self._attached:
+                if slot in self._pending:
+                    frames[slot] = self._pending.pop(slot)
+                    took.append((slot, self._gen[slot]))
+            self._inflight_slots |= {s for s, _ in took}
+        pending = self.enc.dispatch(frames) if took else None
+        prev, self._inflight = self._inflight, (pending, took)
+        if prev is not None and prev[0] is not None:
+            out, session_bytes = self.enc.harvest(prev[0])
+            with self._lock:
+                # a slot can be in BOTH the harvested and the new dispatch;
+                # recompute membership rather than discarding per slot
+                self._inflight_slots = {s for s, _ in self._inflight[1]}
+                for slot, gen in prev[1]:
+                    if slot not in self._attached or self._gen[slot] != gen:
+                        continue
+                    self.coded_bytes[slot] += int(session_bytes[slot])
+                    seq = self._seq[slot]
+                    self._seq[slot] = seq + 1
+                    self._results[slot].append((seq, out[slot]))

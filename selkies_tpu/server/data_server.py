@@ -160,6 +160,10 @@ class DataStreamingServer:
         self.audio_pipeline = None  # wired by main() when audio is enabled
         self._audio_wanted = True   # cleared by STOP_AUDIO until re-requested
         self._last_layout = None    # last xrandr-applied Layout (dedup)
+        #: mesh-batched encode (tpu_mesh setting, BASELINE config 5):
+        #: lazily built from the first display's geometry
+        self.mesh_coordinator = None
+        self._mesh_unavailable = False
 
     # ------------------------------------------------------------------
     # broadcast primitives
@@ -205,6 +209,8 @@ class DataStreamingServer:
     async def stop(self) -> None:
         for st in list(self.display_clients.values()):
             await self._stop_display(st)
+        if self.mesh_coordinator is not None:
+            self.mesh_coordinator.stop()
         if self.audio_pipeline is not None:
             await self.audio_pipeline.stop()
             self.audio_pipeline.close()
@@ -577,11 +583,14 @@ class DataStreamingServer:
         import websockets
 
         fps = st.bp.framerate or 60.0
-        try:
-            encoder = self.encoder_factory(
-                st.width, st.height, self.settings, st.overrides)
-        except TypeError:  # factory without overrides support (tests, custom)
-            encoder = self.encoder_factory(st.width, st.height, self.settings)
+        encoder = self._acquire_mesh_encoder(st, fps)
+        if encoder is None:
+            try:
+                encoder = self.encoder_factory(
+                    st.width, st.height, self.settings, st.overrides)
+            except TypeError:  # factory without overrides support (tests)
+                encoder = self.encoder_factory(
+                    st.width, st.height, self.settings)
         st.encoder = encoder
         try:
             source = self.source_factory(st.width, st.height, fps,
@@ -643,6 +652,51 @@ class DataStreamingServer:
             return pack_h264_stripe(
                 frame_id, s.y_start, s.width, s.height, s.annexb, s.is_key)
         return pack_jpeg_stripe(frame_id, s.y_start, s.jpeg)
+
+    def _acquire_mesh_encoder(self, st: DisplayState, fps: float):
+        """Session facade onto the mesh coordinator when ``tpu_mesh`` is
+        configured (BASELINE config 5); None → solo encoder pipeline.
+
+        Mesh batching covers the JPEG profile with server-wide quality
+        settings (SPMD uniformity); other profiles, mismatched geometry,
+        or slot exhaustion fall back to a solo encoder per display.
+        """
+        spec = str(self.settings.tpu_mesh)
+        if not spec or self._mesh_unavailable:
+            return None
+        profile = st.overrides.get("encoder", self.settings.encoder)
+        if profile != "jpeg":
+            return None
+        if str(self.settings.watermark_path):
+            # the mesh encoder has no watermark stage yet; a configured
+            # watermark must not silently vanish — keep the solo pipeline
+            logger.warning(
+                "tpu_mesh ignored for %s: watermark_path requires the solo "
+                "JPEG pipeline", st.display_id)
+            return None
+        if self.mesh_coordinator is None:
+            try:
+                from ..parallel.coordinator import MeshEncodeCoordinator
+
+                self.mesh_coordinator = MeshEncodeCoordinator(
+                    spec, int(self.settings.tpu_sessions_per_chip),
+                    st.width, st.height, settings=self.settings,
+                    framerate=fps)
+                logger.info(
+                    "mesh batching: %s → %d session slots at %dx%d",
+                    spec, self.mesh_coordinator.n_sessions,
+                    st.width, st.height)
+            except Exception:
+                logger.exception(
+                    "mesh coordinator unavailable; using solo encoders")
+                self._mesh_unavailable = True
+                return None
+        facade = self.mesh_coordinator.acquire(st.width, st.height)
+        if facade is None:
+            logger.warning(
+                "mesh batching: no slot for %s at %dx%d; solo encoder",
+                st.display_id, st.width, st.height)
+        return facade
 
     async def _backpressure_loop(self, st: DisplayState) -> None:
         while True:

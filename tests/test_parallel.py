@@ -96,3 +96,84 @@ def test_entry_compiles_and_runs():
     words, nbytes, base, ovf, damage, new_prev = out
     assert not bool(np.asarray(ovf).any())
     assert int(np.asarray(nbytes).min()) > 0
+
+
+# ---------------------------------------------------------------- config 5
+# Entropy-through sharded step: wire-ready stripes for N sessions from one
+# mesh dispatch, bit-exact with the solo JpegStripeEncoder.
+
+
+def _frame_seq(rng, n_frames):
+    """Per-session frame sequence: random → static → partial change."""
+    f0 = rng.integers(0, 256, (H, W, 3), dtype=np.uint8)
+    seq = [f0, f0.copy()]
+    f2 = f0.copy()
+    f2[H // 2:H // 2 + STRIPE_H] = rng.integers(
+        0, 256, (STRIPE_H, W, 3), dtype=np.uint8)
+    seq.append(f2)
+    while len(seq) < n_frames:
+        seq.append(seq[-1].copy())
+    return seq
+
+
+def test_mesh_stripe_encoder_matches_solo(mesh):
+    from selkies_tpu.encoder.jpeg import JpegStripeEncoder
+    from selkies_tpu.parallel import MeshStripeEncoder
+
+    rng = np.random.default_rng(11)
+    n_frames = 5
+    seqs = [_frame_seq(np.random.default_rng(100 + n), n_frames)
+            for n in range(N_SESSIONS)]
+
+    menc = MeshStripeEncoder(
+        mesh, N_SESSIONS, W, H, stripe_h=STRIPE_H,
+        paint_over_trigger_frames=2)
+    solos = [JpegStripeEncoder(
+        W, H, stripe_height=STRIPE_H, paint_over_trigger_frames=2,
+        entropy="device") for _ in range(N_SESSIONS)]
+
+    for t in range(n_frames):
+        frames = np.stack([seqs[n][t] for n in range(N_SESSIONS)])
+        mesh_out, session_bytes = menc.encode_frames(frames)
+        assert session_bytes.shape == (N_SESSIONS,)
+        for n in range(N_SESSIONS):
+            solo_out = solos[n].encode_frame(seqs[n][t])
+            assert [s.y_start for s in mesh_out[n]] == \
+                [s.y_start for s in solo_out], f"frame {t} session {n}"
+            assert [s.is_paintover for s in mesh_out[n]] == \
+                [s.is_paintover for s in solo_out]
+            for ms, ss in zip(mesh_out[n], solo_out):
+                assert ms.jpeg == ss.jpeg, \
+                    f"frame {t} session {n} stripe {ms.y_start}"
+
+
+def test_mesh_stripe_encoder_none_frames_and_keyframe(mesh):
+    from selkies_tpu.parallel import MeshStripeEncoder
+
+    rng = np.random.default_rng(5)
+    menc = MeshStripeEncoder(mesh, N_SESSIONS, W, H, stripe_h=STRIPE_H)
+    frames = rng.integers(0, 256, (N_SESSIONS, H, W, 3), dtype=np.uint8)
+    out, _ = menc.encode_frames(frames)
+    assert all(len(s) == H // STRIPE_H for s in out)   # first: all stripes
+
+    # idle slots (None) produce nothing and keep the keyframe flag armed
+    menc.force_keyframe(2)
+    out, _ = menc.encode_frames([None] * N_SESSIONS)
+    assert all(len(s) == 0 for s in out)
+    assert menc._first[2]
+    out, _ = menc.encode_frames(frames)                # same content
+    assert len(out[2]) == H // STRIPE_H                # keyframe fired
+    assert all(len(out[n]) == 0 for n in range(N_SESSIONS) if n != 2)
+
+
+def test_parse_mesh_spec():
+    from selkies_tpu.parallel import parse_mesh_spec
+
+    m = parse_mesh_spec("session:4,stripe:2", jax.devices()[:8])
+    assert m.shape["session"] == 4 and m.shape["stripe"] == 2
+    m = parse_mesh_spec("session:8", jax.devices()[:8])
+    assert m.shape["session"] == 8 and m.shape["stripe"] == 1
+    with pytest.raises(ValueError):
+        parse_mesh_spec("session:64", jax.devices()[:8])
+    with pytest.raises(ValueError):
+        parse_mesh_spec("tensor:2", jax.devices()[:8])

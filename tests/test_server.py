@@ -535,3 +535,62 @@ async def test_viewer_join_forces_keyframe(tmp_path):
     finally:
         srv.close()
         await server.stop()
+
+
+@pytest.mark.anyio
+async def test_mesh_batched_sessions_serve_wire_stripes(tmp_path):
+    """BASELINE config 5 as a product path: with tpu_mesh configured, two
+    displays' capture loops feed ONE sharded mesh dispatch (CPU mesh here)
+    and both websockets receive wire-ready 0x03 JPEG stripes."""
+    import io
+    from PIL import Image
+
+    server, app, encoders = make_server(
+        tmp_path,
+        SELKIES_TPU_MESH="session:2,stripe:2",
+        SELKIES_TPU_SESSIONS_PER_CHIP="1",
+    )
+    srv, port = await start_on_free_port(server)
+
+    async def collect_stripes(ws, want):
+        got = []
+        while len(got) < want:
+            m = await asyncio.wait_for(ws.recv(), 30)
+            if isinstance(m, bytes):
+                f = unpack_binary(m)
+                if isinstance(f, VideoStripe):
+                    got.append(f)
+        return got
+
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws1, \
+                websockets.connect(f"ws://127.0.0.1:{port}") as ws2:
+            await handshake(ws1)
+            await handshake(ws2)
+            await ws1.send('SETTINGS,' + json.dumps({
+                "displayId": "primary",
+                "initialClientWidth": 320, "initialClientHeight": 240}))
+            await ws2.send('SETTINGS,' + json.dumps({
+                "displayId": "display2",
+                "initialClientWidth": 320, "initialClientHeight": 240}))
+
+            # primary fans out to all clients; display2 only to its owner —
+            # ws2 must see both streams' stripes, ws1 the primary's
+            s1 = await collect_stripes(ws1, 2)
+            s2 = await collect_stripes(ws2, 2)
+
+            # both displays ride the mesh coordinator, not solo encoders
+            assert server.mesh_coordinator is not None
+            assert len(server.mesh_coordinator._attached) == 2
+            assert encoders == []   # solo factory never invoked
+
+        for f in s1 + s2:
+            assert f.payload.startswith(b"\xff\xd8")
+            assert f.payload.endswith(b"\xff\xd9")
+            img = Image.open(io.BytesIO(f.payload))
+            assert img.size[0] == 320
+    finally:
+        await server.stop()
+        srv.close()
+        assert server.mesh_coordinator is None or \
+            not server.mesh_coordinator._thread
