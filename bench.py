@@ -7,15 +7,23 @@ pipelined (dispatch/D2H-overlapped) encoder exactly as the streaming server
 drives it: per frame, the damage/size metadata and the packed bitstream are
 fetched to the host and assembled into per-stripe JPEGs.
 
+Also measures the rest of the BASELINE matrix on the same chip:
+  * p50/p95 glass-to-glass (capture handoff → stripes decodable on the
+    client side of the wire) — the declared BASELINE latency metric;
+  * tpuenc H.264 1080p (config 2) through the dense one-dispatch device
+    encode + host CAVLC;
+  * 4K JPEG single-chip (config 4's single-chip share; the cross-chip
+    stripe-sharded path is validated by __graft_entry__.dryrun_multichip).
+
 Frames come from a device-resident scrolling source (every stripe damaged
 every frame — the no-shortcuts worst case for damage gating). On production
 hosts capture feeds the chip over PCIe (~0.4 ms for a 6 MB 1080p frame); on
-the tunneled dev chip this benchmark runs on, the same upload costs ~450 ms
-(14 MB/s), which would measure the tunnel, not the encoder — so the source
-materializes frames on device with a jitted roll.
+the tunneled dev chip this benchmark runs on, the same upload costs ~150 ms
+(and D2H pays ~25-100 ms/RPC), which would measure the tunnel, not the
+encoder — so the source materializes frames on device with a jitted roll.
 
 Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "fps", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "fps", "vs_baseline": N, ...}
 vs_baseline is the ratio against the reference's 60 fps 1080p target.
 """
 
@@ -34,16 +42,17 @@ PIPELINE_DEPTH = 12   # deep enough to hide ~100 ms tunneled-D2H latency
 FETCH_GROUP = 4      # frames per D2H read (tunnel allows ~6 concurrent RPCs)
 
 
-def main() -> None:
+def _pipelined_jpeg_fps(width, height, frames, seconds, depth=PIPELINE_DEPTH,
+                        fetch_group=FETCH_GROUP):
     import jax.numpy as jnp
 
     from selkies_tpu.capture.synthetic import DeviceScrollSource
     from selkies_tpu.encoder.jpeg import JpegStripeEncoder
     from selkies_tpu.encoder.pipeline import PipelinedJpegEncoder
 
-    base = JpegStripeEncoder(W, H)
-    src = DeviceScrollSource(W, H)
-    enc = PipelinedJpegEncoder(base, depth=PIPELINE_DEPTH, fetch_group=FETCH_GROUP)
+    base = JpegStripeEncoder(width, height)
+    src = DeviceScrollSource(width, height)
+    enc = PipelinedJpegEncoder(base, depth=depth, fetch_group=fetch_group)
 
     def padded(frame):
         if frame.shape[0] == base.pad_h and frame.shape[1] == base.pad_w:
@@ -54,7 +63,6 @@ def main() -> None:
              (0, base.pad_w - frame.shape[1]), (0, 0)),
             mode="edge")
 
-    done = 0
     for _ in range(WARMUP_FRAMES):  # includes compile
         enc.submit(padded(src.next_frame()))
         for _ in enc.poll():
@@ -62,23 +70,214 @@ def main() -> None:
     for _ in enc.flush():
         pass
 
+    done = 0
+    total_bytes = 0
     start = time.perf_counter()
     submitted = 0
-    total_bytes = 0
-    while submitted < BENCH_FRAMES:
+    while submitted < frames:
         enc.submit(padded(src.next_frame()))
         submitted += 1
         for _seq, stripes in enc.poll():
             done += 1
             total_bytes += sum(len(s.jpeg) for s in stripes)
-        if time.perf_counter() - start > MAX_SECONDS:
+        if time.perf_counter() - start > seconds:
             break
     for _seq, stripes in enc.flush():
         done += 1
         total_bytes += sum(len(s.jpeg) for s in stripes)
     elapsed = time.perf_counter() - start
-
     fps = done / elapsed if elapsed > 0 else 0.0
+    return fps, done, elapsed, total_bytes
+
+
+def bench_h264() -> dict:
+    """Config 2: tpuenc H.264 1080p via the dense one-dispatch device
+    encode (ME/transform/quant/recon + i8 level packing on device, CAVLC
+    on host), software-pipelined depth 2."""
+    import jax.numpy as jnp
+
+    from selkies_tpu.capture.synthetic import DeviceScrollSource
+    from selkies_tpu.encoder.h264 import H264StripeEncoder
+
+    enc = H264StripeEncoder(W, H)
+    src = DeviceScrollSource(W, H)
+
+    def nxt():
+        f = src.next_frame()
+        if f.shape[0] != enc.pad_h:
+            f = jnp.concatenate([f, f[:enc.pad_h - f.shape[0]]], axis=0)
+        return f
+
+    for _ in range(4):
+        enc.encode_frame(nxt())
+    pend, done, nb = [], 0, 0
+    start = time.perf_counter()
+    while done < 100 and time.perf_counter() - start < MAX_SECONDS / 3:
+        pend.append(enc.dispatch(nxt()))
+        if len(pend) >= 3:
+            out = enc.harvest(pend.pop(0))
+            done += 1
+            nb += sum(len(s.annexb) for s in out)
+    while pend:
+        out = enc.harvest(pend.pop(0))
+        done += 1
+        nb += sum(len(s.annexb) for s in out)
+    elapsed = time.perf_counter() - start
+    fps = done / elapsed if elapsed > 0 else 0.0
+    return {
+        "h264_1080p_fps": round(fps, 2),
+        "h264_mean_frame_kb": round(nb / max(done, 1) / 1024, 1),
+        # ~3.3 MB of quantized levels per 1080p frame cross D2H for host
+        # CAVLC; on the tunneled dev chip that transfer IS the ceiling
+        # (sub-ms on production PCIe). Device-side CAVLC is the planned fix.
+        "h264_bottleneck": "coefficient D2H over tunneled transport",
+    }
+
+
+def bench_4k() -> dict:
+    """Config 4 single-chip share: 4K JPEG-stripe throughput."""
+    fps, done, elapsed, total = _pipelined_jpeg_fps(
+        3840, 2160, 120, MAX_SECONDS / 3)
+    return {
+        "fourk_jpeg_fps": round(fps, 2),
+        "fourk_mean_frame_kb": round(total / max(done, 1) / 1024, 1),
+    }
+
+
+def bench_glass_to_glass() -> dict:
+    """p50/p95 capture→client-decodable latency through the REAL server:
+    DataStreamingServer + websocket client on loopback; the client ACKs
+    every frame and PIL-decodes one stripe per frame as the stand-in for
+    the browser's ImageDecoder."""
+    import asyncio
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from selkies_tpu.protocol import unpack_binary, VideoStripe
+    from selkies_tpu.server.app import StreamingApp
+    from selkies_tpu.server.data_server import DataStreamingServer
+    from selkies_tpu.settings import Settings
+
+    from selkies_tpu.capture.synthetic import SyntheticSource
+    from selkies_tpu.server.data_server import default_encoder_factory
+
+    #: wire frame id → capture-handoff time. The wrapper mirrors the
+    #: capture loop's id assignment exactly: ids are handed to non-empty
+    #: results in poll order, which is submission order.
+    fid_times = {}
+
+    class TimedEncoder:
+        def __init__(self, inner):
+            self.inner = inner
+            self._t = {}
+            self._next_fid = 1
+
+        def try_submit(self, frame):
+            seq = self.inner.try_submit(frame)
+            if seq is not None:
+                self._t[seq] = time.monotonic()
+            return seq
+
+        submit = try_submit
+
+        def poll(self):
+            out = self.inner.poll()
+            for seq, stripes in out:
+                t = self._t.pop(seq, None)
+                if stripes and t is not None:
+                    fid_times[self._next_fid] = t
+                    self._next_fid += 1
+            return out
+
+        def flush(self):
+            return self.inner.flush()
+
+        def force_keyframe(self):
+            self.inner.force_keyframe()
+
+        def close(self):
+            close = getattr(self.inner, "close", None)
+            if close:
+                close()
+
+    def encoder_factory(w, h, settings, overrides=None):
+        return TimedEncoder(default_encoder_factory(w, h, settings,
+                                                    overrides))
+
+    def source_factory(w, h, fps, x=0, y=0):
+        return SyntheticSource(w, h, fps, pattern="scroll")
+
+    lat_ms = []
+
+    async def run():
+        import websockets
+        import websockets.asyncio.server as ws_server
+
+        settings = Settings(argv=[], env={"SELKIES_PORT": "0"})
+        app = StreamingApp(settings)
+        server = DataStreamingServer(
+            settings, app=app, source_factory=source_factory,
+            encoder_factory=encoder_factory, host="127.0.0.1")
+        app.data_server = server
+        server._stop_event = asyncio.Event()
+        srv = await ws_server.serve(server.ws_handler, "127.0.0.1", 0,
+                                    compression=None, max_size=None)
+        port = srv.sockets[0].getsockname()[1]
+
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await ws.recv()             # MODE
+            await ws.recv()             # server_settings
+            await ws.send('SETTINGS,{"displayId": "primary", '
+                          '"initialClientWidth": 1920, '
+                          '"initialClientHeight": 1080, '
+                          '"framerate": 30}')
+            seen = set()
+            deadline = time.monotonic() + 30.0
+            while len(lat_ms) < 140 and time.monotonic() < deadline:
+                try:
+                    m = await asyncio.wait_for(ws.recv(), 10)
+                except asyncio.TimeoutError:
+                    break
+                if not isinstance(m, bytes):
+                    continue
+                f = unpack_binary(m)
+                if not isinstance(f, VideoStripe):
+                    continue
+                if f.frame_id in seen:
+                    continue
+                seen.add(f.frame_id)
+                # decode one stripe as the browser-ImageDecoder stand-in;
+                # latency = capture handoff → stripe decodable client-side
+                Image.open(io.BytesIO(f.payload)).load()
+                t0 = fid_times.get(f.frame_id)
+                if t0 is not None:
+                    lat_ms.append((time.monotonic() - t0) * 1000.0)
+                await ws.send(f"CLIENT_FRAME_ACK {f.frame_id}")
+        await server.stop()
+        srv.close()
+
+    asyncio.run(run())
+    # the first frames pay jit warmup + display reconfigure churn
+    samples = lat_ms[20:] if len(lat_ms) > 40 else lat_ms
+    if not samples:
+        return {"p50_glass_to_glass_ms": None}
+    arr = np.sort(np.asarray(samples))
+    return {
+        "p50_glass_to_glass_ms": round(float(arr[len(arr) // 2]), 1),
+        "p95_glass_to_glass_ms": round(float(arr[int(len(arr) * 0.95)]), 1),
+        "latency_samples": len(arr),
+        # each hop (6 MB capture H2D, metadata/bitstream D2H) pays a fixed
+        # ~25-350 ms RPC on the tunneled dev chip; on PCIe the same hops
+        # are sub-millisecond, so this number is transport-bound here
+        "latency_note": "tunneled-transport RPC floor dominates",
+    }
+
+
+def main() -> None:
+    fps, done, elapsed, total_bytes = _pipelined_jpeg_fps(
+        W, H, BENCH_FRAMES, MAX_SECONDS)
     result = {
         "metric": "tpuenc_jpeg_1080p_encode_fps",
         "value": round(fps, 2),
@@ -88,6 +287,18 @@ def main() -> None:
         "elapsed_s": round(elapsed, 2),
         "mean_frame_kb": round(total_bytes / max(done, 1) / 1024, 1),
     }
+    try:
+        result.update(bench_glass_to_glass())
+    except Exception as e:  # the headline number must survive a sub-bench
+        result["glass_to_glass_error"] = repr(e)
+    try:
+        result.update(bench_h264())
+    except Exception as e:
+        result["h264_error"] = repr(e)
+    try:
+        result.update(bench_4k())
+    except Exception as e:
+        result["fourk_error"] = repr(e)
     print(json.dumps(result))
 
 

@@ -19,12 +19,10 @@ Split of work (TPU-first, SURVEY.md §7 step 6):
 
 from __future__ import annotations
 
-import functools
 import logging
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -83,11 +81,17 @@ def _nal(nal_type: int, rbsp: bytes, ref_idc: int = 3) -> bytes:
     return b"\x00\x00\x00\x01" + bytes(((ref_idc << 5) | nal_type,)) + rbsp
 
 
-def make_sps(width: int, height: int, *, level_idc: int = 40,
-             full_range: bool = True) -> bytes:
-    """Constrained-Baseline SPS for a (possibly cropped) 4:2:0 frame."""
+def make_sps(width: int, height: int, *, coded_height: Optional[int] = None,
+             level_idc: int = 40, full_range: bool = True) -> bytes:
+    """Constrained-Baseline SPS for a (possibly cropped) 4:2:0 frame.
+
+    ``coded_height`` (a MB multiple ≥ height) must match the rows the
+    slices actually code — the uniform stripe grid encodes full
+    ``stripe_h`` rows even for a partial last stripe, and an SPS declaring
+    fewer MB rows than the slice codes is an invalid bitstream
+    (libavcodec: "first_mb_in_slice overflow")."""
     mb_w = (width + 15) // 16
-    mb_h = (height + 15) // 16
+    mb_h = ((coded_height or height) + 15) // 16
     crop_r = (mb_w * 16 - width) // 2
     crop_b = (mb_h * 16 - height) // 2
     bw = _BitWriter()
@@ -214,9 +218,6 @@ class _StripeState:
     need_idr: bool = True
     static_frames: int = 0
     painted_over: bool = False
-    ref_y: Optional[jnp.ndarray] = None
-    ref_cb: Optional[jnp.ndarray] = None
-    ref_cr: Optional[jnp.ndarray] = None
 
 
 class H264StripeEncoder:
@@ -245,160 +246,202 @@ class H264StripeEncoder:
         self.search = search
         self.pad_w = (width + MB - 1) // MB * MB
         sh = height if fullframe else stripe_height
+        sh = (sh + MB - 1) // MB * MB
         self.stripe_h = sh
         self.stripes: List[_StripeState] = []
         y = 0
         while y < height:
             h = min(sh, height - y)
-            self.stripes.append(_StripeState(
-                y0=y, h=h, pad_h=(h + MB - 1) // MB * MB))
+            self.stripes.append(_StripeState(y0=y, h=h, pad_h=sh))
             y += h
+        #: uniform stripe grid: total padded height is S × stripe_h so the
+        #: whole frame encodes as one vmapped device dispatch
+        self.n_stripes = len(self.stripes)
+        self.pad_h = self.n_stripes * sh
         self._sps_pps: Dict[int, bytes] = {}
-        self._prev_rgb: Optional[jnp.ndarray] = None
+
+        # device state chains (donated through each dispatch)
+        self._prev_y = jnp.zeros((self.pad_h, self.pad_w), jnp.uint8)
+        self._prev_cb = jnp.zeros((self.pad_h // 2, self.pad_w // 2),
+                                  jnp.uint8)
+        self._prev_cr = jnp.zeros_like(self._prev_cb)
+        self._ref_y = jnp.zeros_like(self._prev_y)
+        self._ref_cb = jnp.zeros_like(self._prev_cb)
+        self._ref_cr = jnp.zeros_like(self._prev_cr)
+
+        n = (sh // MB) * (self.pad_w // MB)
+        self._shapes = [((n, 2), 2 * n), ((n, 16, 4, 4), 256 * n),
+                        ((n, 4, 4), 16 * n), ((n, 2, 2, 2), 8 * n),
+                        ((n, 2, 4, 4, 4), 128 * n)]
+        self._stripe_words = sum(s for _, s in self._shapes)
 
     # -- helpers -----------------------------------------------------------
 
     def _sps_pps_for(self, st: _StripeState) -> bytes:
         key = st.h
         if key not in self._sps_pps:
-            self._sps_pps[key] = (make_sps(self.width, st.h) + make_pps())
+            self._sps_pps[key] = (
+                make_sps(self.width, st.h, coded_height=self.stripe_h)
+                + make_pps())
         return self._sps_pps[key]
-
-    def _damage_flags(self, rgb: jnp.ndarray) -> np.ndarray:
-        if self._prev_rgb is None:
-            return np.ones(len(self.stripes), bool)
-        flags = _stripe_damage(rgb, self._prev_rgb,
-                               tuple(s.y0 for s in self.stripes),
-                               tuple(s.h for s in self.stripes))
-        return np.asarray(flags)
 
     # -- encode ------------------------------------------------------------
 
-    def encode_frame(self, rgb) -> List[H264Stripe]:
-        """RGB (H, W, 3) uint8 → encoded stripes (only damaged/paint-over)."""
+    def dispatch(self, rgb) -> "_H264Pending":
+        """One dense device dispatch for the whole frame (every stripe);
+        pair with :meth:`harvest`. Damage detection, reference-plane
+        selection, and i8 level packing all happen inside the single jit
+        program — the host's only per-frame read is the packed buffer."""
         rgb = jnp.asarray(rgb)
-        damage = self._damage_flags(rgb)
-        self._prev_rgb = rgb
+        y, cb, cr = dev.prepare_planes(rgb, self.pad_h, self.pad_w)
 
-        y_full, cb_full, cr_full = dev.prepare_planes(
-            rgb, self.height, self.pad_w)
-
-        # Phase 1 — dispatch every damaged stripe's device encode (async;
-        # dispatches pipeline on the device stream).
-        pending = []     # (st, enc_out, is_idr, qp)
-        for i, st in enumerate(self.stripes):
-            paint_over = False
-            if not damage[i] and not st.need_idr:
-                st.static_frames += 1
+        is_idr = any(st.need_idr for st in self.stripes)
+        paint = np.zeros(self.n_stripes, np.int8)
+        if not is_idr:
+            for i, st in enumerate(self.stripes):
+                # candidacy from *previous* frames' history; optimistic
+                # mark so in-flight frames don't re-trigger (cleared again
+                # by damage at harvest)
                 if (st.static_frames >= self.paint_over_trigger
                         and not st.painted_over):
-                    paint_over = True
+                    paint[i] = 1
                     st.painted_over = True
-                else:
-                    continue
-            else:
-                st.static_frames = 0
-                st.painted_over = False
 
-            sy = _pad_stripe(y_full, st.y0, st.h, st.pad_h)
-            scb = _pad_stripe(cb_full, st.y0 // 2, st.h // 2, st.pad_h // 2)
-            scr = _pad_stripe(cr_full, st.y0 // 2, st.h // 2, st.pad_h // 2)
-            qp = self.paint_over_qp if paint_over else self.qp
-            if st.need_idr or st.ref_y is None:
-                enc = dev.encode_stripe_idr(sy, scb, scr, qp)
-                pending.append((st, enc, True, qp))
-            else:
-                enc = dev.encode_stripe_p(
-                    sy, scb, scr, st.ref_y, st.ref_cb, st.ref_cr, qp,
-                    self.search)
-                pending.append((st, enc, False, qp))
+        if is_idr:
+            (flat8, flat16, self._prev_y, self._prev_cb, self._prev_cr,
+             self._ref_y, self._ref_cb, self._ref_cr) = dev.encode_frame_idr(
+                y, cb, cr, self._prev_y, self._prev_cb, self._prev_cr,
+                self._ref_y, self._ref_cb, self._ref_cr,
+                jnp.int32(self.qp),
+                n_stripes=self.n_stripes, sh=self.stripe_h)
+            fetch = flat16
+        else:
+            (flat8, flat16, self._prev_y, self._prev_cb, self._prev_cr,
+             self._ref_y, self._ref_cb, self._ref_cr) = dev.encode_frame_p(
+                y, cb, cr, self._prev_y, self._prev_cb, self._prev_cr,
+                self._ref_y, self._ref_cb, self._ref_cr,
+                jnp.asarray(paint, jnp.int32),
+                jnp.int32(self.qp), jnp.int32(self.paint_over_qp),
+                n_stripes=self.n_stripes, sh=self.stripe_h,
+                search=self.search)
+            fetch = flat8
+        fetch.copy_to_host_async()
+        qp_arr = np.where(paint != 0, self.paint_over_qp, self.qp)
+        return _H264Pending(fetch=fetch, flat16=flat16, is_idr=is_idr,
+                            paint=paint, qp=qp_arr)
 
-        if not pending:
-            return []
-
-        # Phase 2 — ONE device concat + ONE host read for every stripe's
-        # coefficients (i16 halves the transfer; levels/MVs fit easily).
-        # Per-fetch latency dominates RPC-attached devices: the naive
-        # per-array asarray() path costs 5 reads × stripes per frame.
-        # Each stripe flattens through a per-geometry jitted pack so the
-        # final concatenate only varies with the pending COUNT, not with
-        # which subset of stripes was damaged.
-        chunks = []
-        splits = []
-        for st, enc, is_idr, qp in pending:
-            arrs = (enc.mv, enc.luma, enc.luma_dc, enc.chroma_dc,
-                    enc.chroma_ac)
-            shapes = [a.shape for a in arrs]
-            sizes = [int(np.prod(s)) for s in shapes]
-            splits.append((shapes, sizes))
-            chunks.append(_flatten_stripe_i16(*arrs))
-        flat = np.asarray(
-            chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks))
+    def harvest(self, p: "_H264Pending") -> List[H264Stripe]:
+        """Entropy-code one dispatched frame (host CAVLC over the fetched
+        levels). Must be called in dispatch order."""
+        host = np.asarray(p.fetch)
+        if p.is_idr:
+            levels16 = host
+            damage = np.ones(self.n_stripes, bool)
+            ovf = np.zeros(self.n_stripes, bool)
+        else:
+            levels16 = None
+            damage = host[:, -2] != 0
+            ovf = host[:, -1] != 0
+            # exact re-reads for clipped stripes, all started before any
+            # blocks (rare: |level| > 127 at streaming QPs)
+            refetch = {}
+            for i in range(self.n_stripes):
+                if ovf[i] and (damage[i] or p.paint[i]):
+                    sl = p.flat16[i]
+                    sl.copy_to_host_async()
+                    refetch[i] = sl
 
         out: List[H264Stripe] = []
-        pos = 0
         mb_w = self.pad_w // MB
-        for (st, enc, is_idr, qp), (shapes, sizes) in zip(pending, splits):
+        mb_h = self.stripe_h // MB
+        for i, st in enumerate(self.stripes):
+            if p.is_idr:
+                emit, is_key = True, True
+                st.static_frames = 0
+                st.painted_over = False
+            elif damage[i]:
+                emit, is_key = True, False
+                st.static_frames = 0
+                st.painted_over = False
+            elif p.paint[i]:
+                emit, is_key = True, False
+                st.static_frames += 1
+            else:
+                emit = False
+                st.static_frames += 1
+            if not emit:
+                continue
+
+            if p.is_idr:
+                row = levels16[i].astype(np.int32)
+            elif ovf[i]:
+                row = np.asarray(refetch[i]).astype(np.int32)
+            else:
+                row = host[i, :self._stripe_words].astype(np.int32)
             parts = []
-            for shape, size in zip(shapes, sizes):
-                parts.append(flat[pos:pos + size].reshape(shape)
-                             .astype(np.int32))
+            pos = 0
+            for shape, size in self._shapes:
+                parts.append(row[pos:pos + size].reshape(shape))
                 pos += size
             mv, luma, luma_dc, chroma_dc, chroma_ac = parts
-            mb_h = st.pad_h // MB
-            if is_idr:
-                nals = encode_picture_nals_np(
-                    mv, luma, luma_dc, chroma_dc, chroma_ac,
-                    is_idr=True, mb_w=mb_w, mb_h=mb_h, qp=qp,
-                    frame_num=0, idr_pic_id=st.idr_pic_id)
-                payload = self._sps_pps_for(st) + nals
+            qp = int(p.qp[i])
+            try:
+                if is_key:
+                    nals = encode_picture_nals_np(
+                        mv, luma, luma_dc, chroma_dc, chroma_ac,
+                        is_idr=True, mb_w=mb_w, mb_h=mb_h, qp=qp,
+                        frame_num=0, idr_pic_id=st.idr_pic_id)
+                    payload = self._sps_pps_for(st) + nals
+                else:
+                    payload = encode_picture_nals_np(
+                        mv, luma, luma_dc, chroma_dc, chroma_ac,
+                        is_idr=False, mb_w=mb_w, mb_h=mb_h, qp=qp,
+                        frame_num=st.frame_num)
+            except Exception:
+                # the device ref already advanced to a reconstruction the
+                # decoder will never see — resynchronize with an IDR
+                # instead of drifting every following P frame
+                logger.exception("entropy coding failed for stripe %d; "
+                                 "forcing IDR resync", i)
+                st.need_idr = True
+                continue
+            if is_key:
                 st.frame_num = 1
                 st.idr_pic_id = (st.idr_pic_id + 1) % 16
                 st.need_idr = False
             else:
-                payload = encode_picture_nals_np(
-                    mv, luma, luma_dc, chroma_dc, chroma_ac,
-                    is_idr=False, mb_w=mb_w, mb_h=mb_h, qp=qp,
-                    frame_num=st.frame_num)
                 st.frame_num = (st.frame_num + 1) % 16
-            # commit the reference ONLY once the bitstream for this stripe
-            # exists: an entropy failure must not leave the encoder
-            # predicting from a reconstruction the decoder never got
-            st.ref_y, st.ref_cb, st.ref_cr = (
-                enc.recon_y, enc.recon_cb, enc.recon_cr)
             out.append(H264Stripe(
                 y_start=st.y0, width=self.width, height=st.h,
-                annexb=payload, is_key=is_idr))
+                annexb=payload, is_key=is_key))
         return out
+
+    def encode_frame(self, rgb) -> List[H264Stripe]:
+        """RGB (H, W, 3) uint8 → encoded stripes (only damaged/paint-over)."""
+        return self.harvest(self.dispatch(rgb))
 
     def request_keyframe(self) -> None:
         """Force IDR on every stripe (client join / PIPELINE_RESETTING)."""
         for st in self.stripes:
             st.need_idr = True
 
-
-@jax.jit
-def _flatten_stripe_i16(mv, luma, luma_dc, chroma_dc, chroma_ac):
-    """One stripe's device outputs → one flat i16 buffer (fixed shape per
-    stripe geometry, so the cross-stripe concatenate stays shape-stable)."""
-    return jnp.concatenate([
-        a.reshape(-1).astype(jnp.int16)
-        for a in (mv, luma, luma_dc, chroma_dc, chroma_ac)])
-
-
-@functools.partial(jax.jit, static_argnames=("y0s", "hs"))
-def _stripe_damage(rgb, prev, y0s, hs):
-    flags = []
-    for y0, h in zip(y0s, hs):
-        a = jax.lax.dynamic_slice_in_dim(rgb, y0, h, axis=0)
-        b = jax.lax.dynamic_slice_in_dim(prev, y0, h, axis=0)
-        flags.append(jnp.any(a != b))
-    return jnp.stack(flags)
+    def stripe_ref(self, i: int):
+        """Host copies of stripe i's reference planes (conformance oracle)."""
+        sh = self.stripe_h
+        y = np.asarray(self._ref_y[i * sh:(i + 1) * sh])
+        cb = np.asarray(self._ref_cb[i * sh // 2:(i + 1) * sh // 2])
+        cr = np.asarray(self._ref_cr[i * sh // 2:(i + 1) * sh // 2])
+        return y, cb, cr
 
 
-@functools.partial(jax.jit, static_argnames=("y0", "h", "pad_h"))
-def _pad_stripe(plane, y0: int, h: int, pad_h: int):
-    s = jax.lax.dynamic_slice_in_dim(plane, y0, h, axis=0)
-    if pad_h != h:
-        s = jnp.pad(s, ((0, pad_h - h), (0, 0)), mode="edge")
-    return s
+@dataclass
+class _H264Pending:
+    """One in-flight dense H.264 dispatch."""
+
+    fetch: object               # async-fetching buffer (i8 for P, i16 IDR)
+    flat16: object              # exact levels (overflow re-reads)
+    is_idr: bool
+    paint: np.ndarray
+    qp: np.ndarray
+
+

@@ -395,24 +395,20 @@ class MeshStripeEncoder:
                 raise ValueError(f"device batch must be pre-padded to {want}")
             batch = frames
         elif isinstance(frames, np.ndarray) and frames.ndim == 4:
-            batch = np.zeros((self.n_sessions, self.pad_h, self.pad_w, 3),
-                             np.uint8)
             for n in range(self.n_sessions):
-                batch[n] = self._pad(np.asarray(frames[n], np.uint8))
-            self._last_host[:] = batch
+                self._last_host[n] = self._pad(np.asarray(frames[n], np.uint8))
+            batch = self._last_host
         else:
-            batch = np.zeros((self.n_sessions, self.pad_h, self.pad_w, 3),
-                             np.uint8)
+            # the persistent host batch doubles as the last-frame cache:
+            # slots without a new frame this tick keep their old pixels
+            # (damage then reads all-zero on device) with no realloc and
+            # never a blocking device prev readback
             for n, f in enumerate(frames):
                 if f is None:
-                    # idle slot: re-present the host-cached last frame so
-                    # damage reads all-zero — never a device prev readback,
-                    # which would block on the in-flight step every tick
-                    batch[n] = self._last_host[n]
                     reuse_prev[n] = True
                 else:
-                    batch[n] = self._pad(np.asarray(f, np.uint8))
-                    self._last_host[n] = batch[n]
+                    self._last_host[n] = self._pad(np.asarray(f, np.uint8))
+            batch = self._last_host
 
         paint_candidate = (
             self.use_paint_over_quality

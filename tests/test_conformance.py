@@ -61,13 +61,11 @@ def test_h264_idr_bit_exact_with_recon():
         got = decoders[s.y_start].decode(s.annexb)
         assert got is not None
         dy, du, dv = got
-        st = next(x for x in enc.stripes if x.y0 == s.y_start)
-        ry = np.asarray(st.ref_y)[:s.height, :w]
-        rcb = np.asarray(st.ref_cb)[:s.height // 2, :w // 2]
-        rcr = np.asarray(st.ref_cr)[:s.height // 2, :w // 2]
-        np.testing.assert_array_equal(dy, ry)
-        np.testing.assert_array_equal(du, rcb)
-        np.testing.assert_array_equal(dv, rcr)
+        i = s.y_start // enc.stripe_h
+        ry, rcb, rcr = enc.stripe_ref(i)
+        np.testing.assert_array_equal(dy, ry[:s.height, :w])
+        np.testing.assert_array_equal(du, rcb[:s.height // 2, :w // 2])
+        np.testing.assert_array_equal(dv, rcr[:s.height // 2, :w // 2])
     for d in decoders.values():
         d.close()
 
@@ -87,14 +85,12 @@ def test_h264_p_frames_bit_exact_over_gop():
             got = decoders[s.y_start].decode(s.annexb)
             assert got is not None, f"t={t} stripe {s.y_start}: no frame out"
             dy, du, dv = got
-            st = next(x for x in enc.stripes if x.y0 == s.y_start)
+            ry, rcb, rcr = enc.stripe_ref(s.y_start // enc.stripe_h)
             np.testing.assert_array_equal(
-                dy, np.asarray(st.ref_y)[:s.height, :w],
+                dy, ry[:s.height, :w],
                 err_msg=f"t={t} stripe {s.y_start} luma mismatch")
-            np.testing.assert_array_equal(
-                du, np.asarray(st.ref_cb)[:s.height // 2, :w // 2])
-            np.testing.assert_array_equal(
-                dv, np.asarray(st.ref_cr)[:s.height // 2, :w // 2])
+            np.testing.assert_array_equal(du, rcb[:s.height // 2, :w // 2])
+            np.testing.assert_array_equal(dv, rcr[:s.height // 2, :w // 2])
     for d in decoders.values():
         d.close()
 
@@ -131,8 +127,7 @@ def test_h264_fullframe_mode():
         (s,) = stripes
         assert s.height == h
         dy, _, _ = dec.decode(s.annexb)
-        np.testing.assert_array_equal(
-            dy, np.asarray(enc.stripes[0].ref_y)[:h, :w])
+        np.testing.assert_array_equal(dy, enc.stripe_ref(0)[0][:h, :w])
     dec.close()
 
 
@@ -166,3 +161,27 @@ def test_jpeg_stripes_decode_and_match_source(entropy):
         cerr = np.abs(du[:cref.shape[0], :w // 2].astype(np.int32)
                       - cref[:, :w // 2].astype(np.int32))
         assert cerr.mean() < 4.5, (s.y_start, cerr.mean())
+
+
+def test_h264_partial_last_stripe_decodes():
+    """A display height that is not a stripe multiple leaves a short last
+    stripe; the uniform encode grid codes full stripe_h rows, so the SPS
+    must declare the coded height and crop — libavcodec rejected the old
+    mismatched headers with 'first_mb_in_slice overflow'."""
+    from selkies_tpu.encoder.h264 import H264StripeEncoder
+
+    w, h, sh = 128, 80, 64           # stripes: 64 rows + 16-row remainder
+    enc = H264StripeEncoder(w, h, stripe_height=sh, qp=24)
+    frame = _smooth_frame(h, w, seed=7)
+    stripes = enc.encode_frame(frame)
+    assert [s.height for s in stripes] == [64, 16]
+    for s in stripes:
+        dec = conformance.ConformanceDecoder("h264", max_dim=256)
+        got = dec.decode(s.annexb)
+        dec.close()
+        assert got is not None, f"stripe {s.y_start} undecodable"
+        dy, _, _ = got
+        assert dy.shape == (s.height, w)
+        i = s.y_start // enc.stripe_h
+        ry, _, _ = enc.stripe_ref(i)
+        np.testing.assert_array_equal(dy, ry[:s.height, :w])
