@@ -41,6 +41,9 @@ logger = logging.getLogger("selkies_tpu.rtc.signaling")
 
 
 class SignalingServer:
+    #: whole-body Response API: bound what a single /files download pins
+    MAX_DOWNLOAD_BYTES = 256 * 1024 * 1024
+
     def __init__(
         self,
         addr: str = "0.0.0.0",
@@ -60,10 +63,14 @@ class SignalingServer:
         stun_port=None,
         turn_auth_header_name: str = "x-auth-user",
         rtc_config: Optional[str] = None,
+        files_root: Optional[str] = None,
     ):
         self.addr = addr
         self.port = port
         self.web_root = os.path.realpath(web_root) if web_root else None
+        #: downloadable-files tree (the reference dashboard's "Download
+        #: Files" iframe points at ./files/ — legacy FILE_MANAGER_PATH)
+        self.files_root = os.path.realpath(files_root) if files_root else None
         self.health_path = health_path.rstrip("/")
         self.keepalive_timeout = keepalive_timeout
         self.enable_basic_auth = enable_basic_auth
@@ -133,6 +140,9 @@ class SignalingServer:
         if path.rstrip("/") == "/turn":
             return self._turn_response(request)
 
+        if path.split("?")[0] == "/files" or path.split("?")[0].startswith("/files/"):
+            return await asyncio.to_thread(self._files_response, path)
+
         # disk I/O off the event loop: a big asset read must not stall
         # concurrent SDP/ICE relays
         return await asyncio.to_thread(self._static_response, path)
@@ -158,6 +168,91 @@ class SignalingServer:
             return self._response(
                 http.HTTPStatus.OK, cfg.encode() if isinstance(cfg, str) else cfg, hdrs
             )
+        return self._response(http.HTTPStatus.NOT_FOUND, b"404 NOT FOUND")
+
+    def _files_response(self, path: str) -> Response:
+        """File-download plane: directory listings + attachment serving
+        from ``files_root`` (reference: dashboard "Download Files" iframe
+        at ./files/, FILE_MANAGER_PATH at reference selkies.py:98-103)."""
+        import html
+        import urllib.parse
+
+        if self.files_root is None:
+            return self._response(http.HTTPStatus.NOT_FOUND,
+                                  b"file downloads disabled")
+        rel = urllib.parse.unquote(path.split("?")[0][len("/files"):])
+        full = os.path.realpath(
+            os.path.join(self.files_root, rel.lstrip("/")))
+        if os.path.commonpath((self.files_root, full)) != self.files_root:
+            return self._response(http.HTTPStatus.NOT_FOUND, b"404 NOT FOUND")
+        if os.path.isdir(full):
+            rows = []
+            base = "/files" + (rel.rstrip("/") if rel.strip("/") else "")
+
+            def href(path: str) -> str:
+                # quote THEN escape: a hostile directory name must neither
+                # break out of the attribute nor smuggle markup
+                return html.escape(urllib.parse.quote(path, safe="/"))
+
+            if full != self.files_root:
+                rows.append('<li><a href="%s/">../</a></li>'
+                            % href(os.path.dirname(base.rstrip("/"))))
+            try:
+                names = sorted(os.listdir(full))
+            except OSError:
+                return self._response(http.HTTPStatus.NOT_FOUND,
+                                      b"404 NOT FOUND")
+            for name in names:
+                p = os.path.join(full, name)
+                try:
+                    if os.path.isdir(p):
+                        rows.append(f'<li><a href="{href(base + "/" + name)}/">'
+                                    f'{html.escape(name)}/</a></li>')
+                    else:
+                        size = os.path.getsize(p)
+                        rows.append(
+                            f'<li><a href="{href(base + "/" + name)}" download>'
+                            f'{html.escape(name)}</a>'
+                            f' <small>({size:,} B)</small></li>')
+                except OSError:
+                    continue    # dangling symlink / raced deletion
+            body = (
+                "<!DOCTYPE html><meta charset=utf-8>"
+                "<style>body{font:14px system-ui;background:#101214;"
+                "color:#d7dadd;padding:14px}a{color:#9ecbff}"
+                "li{margin:3px 0}</style>"
+                f"<h3>Files — {html.escape(rel or '/')}</h3>"
+                "<ul>" + "".join(rows) + "</ul>").encode()
+            hdrs = Headers()
+            hdrs["Content-Type"] = "text/html; charset=utf-8"
+            return self._response(http.HTTPStatus.OK, body, hdrs)
+        if os.path.isfile(full):
+            import re as _re
+
+            try:
+                size = os.path.getsize(full)
+            except OSError:
+                return self._response(http.HTTPStatus.NOT_FOUND,
+                                      b"404 NOT FOUND")
+            # the Response API is whole-body; cap what one request may pin
+            # in memory rather than letting a Desktop disk image OOM the
+            # streaming host
+            if size > self.MAX_DOWNLOAD_BYTES:
+                return self._response(
+                    http.HTTPStatus.REQUEST_ENTITY_TOO_LARGE,
+                    b"file exceeds the download size limit")
+            mime = mimetypes.guess_type(full)[0] or "application/octet-stream"
+            with open(full, "rb") as f:
+                body = f.read()
+            # header values must stay single-line and quote-free: strip
+            # control characters and quotes from the advertised filename
+            safe_name = _re.sub(r'[\x00-\x1f"\\\x7f]', "_",
+                                os.path.basename(full)) or "download"
+            hdrs = Headers()
+            hdrs["Content-Type"] = mime
+            hdrs["Content-Disposition"] = (
+                'attachment; filename="%s"' % safe_name)
+            return self._response(http.HTTPStatus.OK, body, hdrs)
         return self._response(http.HTTPStatus.NOT_FOUND, b"404 NOT FOUND")
 
     def _static_response(self, path: str) -> Response:

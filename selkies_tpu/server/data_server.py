@@ -39,6 +39,15 @@ STATS_INTERVAL_S = 5.0
 UPLOAD_DIR_ENV = "SELKIES_UPLOAD_DIR"
 
 
+def upload_dir() -> str:
+    """The file-manager root (uploads land here; /files serves it) —
+    reference FILE_MANAGER_PATH, selkies.py:98-103."""
+    d = os.environ.get(UPLOAD_DIR_ENV) or os.path.join(
+        os.path.expanduser("~"), "Desktop")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def default_encoder_factory(
     width: int, height: int, settings: Settings,
     overrides: Optional[Dict[str, Any]] = None,
@@ -719,10 +728,7 @@ class DataStreamingServer:
     # file upload (path-sanitized, reference selkies.py:1843-1952)
 
     def _upload_dir(self) -> str:
-        d = os.environ.get(UPLOAD_DIR_ENV) or os.path.join(
-            os.path.expanduser("~"), "Desktop")
-        os.makedirs(d, exist_ok=True)
-        return d
+        return upload_dir()
 
     async def _on_upload_start(self, websocket, args) -> None:
         if "upload" not in self.settings.file_transfers:
@@ -736,7 +742,10 @@ class DataStreamingServer:
             return
         root = os.path.realpath(self._upload_dir())
         norm = os.path.normpath(rel_path)
-        if norm.startswith(("/", "\\")) or ".." in norm.split(os.sep):
+        if norm.startswith(("/", "\\")) or ".." in norm.split(os.sep) \
+                or any(ord(c) < 0x20 or c in '"\x7f' for c in norm):
+            # control characters / quotes in names would otherwise reach
+            # the /files listing + Content-Disposition planes
             await websocket.send(f"FILE_UPLOAD_ERROR:{rel_path}:invalid path")
             return
         target = os.path.realpath(os.path.join(root, norm))

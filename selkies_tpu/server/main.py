@@ -116,9 +116,15 @@ async def _amain(settings: Settings) -> int:
 
         web_root = bundled_web_root()
         if web_root is not None:
+            files_root = None
+            if "download" in settings.file_transfers:
+                from .data_server import upload_dir
+
+                files_root = upload_dir()
             web_server = SignalingServer(
                 addr="0.0.0.0", port=int(settings.web_port),
                 web_root=web_root,
+                files_root=files_root,
                 turn_shared_secret=str(settings.turn_shared_secret),
                 turn_host=str(settings.turn_host),
                 turn_port=str(settings.turn_port),

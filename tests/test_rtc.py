@@ -6,6 +6,7 @@ legacy/webrtc.py RTC-config plumbing, and addons/turn-rest/app.py
 
 import asyncio
 import base64
+import os
 import hashlib
 import hmac as hmac_mod
 import json
@@ -311,6 +312,95 @@ def test_signaling_rooms():
         await w2.close()
         assert await w1.recv() == "ROOM_PEER_LEFT r2"
         await w1.close()
+        await server.stop()
+        stask.cancel()
+
+    asyncio.run(run())
+
+
+def test_files_download_plane(tmp_path):
+    """The dashboard's "Download files" modal points at ./files/ — a
+    directory listing + attachment serving from the file-manager root
+    (reference: Sidebar.jsx files modal iframe; FILE_MANAGER_PATH)."""
+    web = tmp_path / "web"
+    web.mkdir()
+    (web / "index.html").write_text("<html>ok</html>")
+    froot = tmp_path / "managed"
+    (froot / "sub").mkdir(parents=True)
+    (froot / "report.txt").write_text("data!")
+    (froot / "sub" / "inner.bin").write_bytes(b"\x00\x01\x02")
+
+    async def run():
+        server, stask = _start_server(
+            web_root=str(web), files_root=str(froot))
+        port = await _wait_port(server)
+
+        def get(path):
+            req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, dict(r.headers), r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, dict(e.headers), e.read()
+
+        status, _, body = await asyncio.to_thread(get, "/files/")
+        assert status == 200
+        assert b"report.txt" in body and b"sub/" in body
+
+        status, hdrs, body = await asyncio.to_thread(get, "/files/report.txt")
+        assert status == 200 and body == b"data!"
+        assert "attachment" in hdrs.get("Content-Disposition", "")
+
+        status, _, body = await asyncio.to_thread(get, "/files/sub/")
+        assert status == 200 and b"inner.bin" in body
+        status, _, body = await asyncio.to_thread(get, "/files/sub/inner.bin")
+        assert status == 200 and body == b"\x00\x01\x02"
+
+        status, _, _ = await asyncio.to_thread(get, "/files/../web/index.html")
+        assert status == 404
+        status, _, _ = await asyncio.to_thread(get, "/files/absent.txt")
+        assert status == 404
+        await server.stop()
+        stask.cancel()
+
+    asyncio.run(run())
+
+
+def test_files_plane_hostile_names(tmp_path):
+    """Hostile entry names must neither break the listing markup (XSS)
+    nor inject headers; broken symlinks must not 500 the listing."""
+    web = tmp_path / "web"
+    web.mkdir()
+    froot = tmp_path / "managed"
+    (froot / '"><script>alert(1)<').mkdir(parents=True)
+    (froot / "ok.txt").write_text("x")
+    os.symlink(str(tmp_path / "gone"), str(froot / "dangling"))
+
+    async def run():
+        server, stask = _start_server(
+            web_root=str(web), files_root=str(froot))
+        port = await _wait_port(server)
+
+        def get(path):
+            req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, dict(r.headers), r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, dict(e.headers), e.read()
+
+        status, _, body = await asyncio.to_thread(get, "/files/")
+        assert status == 200
+        assert b"<script>alert" not in body      # escaped, not raw
+        assert b"ok.txt" in body
+
+        # oversized file → 413 instead of pinning it all in memory
+        big = froot / "big.bin"
+        with open(big, "wb") as f:
+            f.seek(SignalingServer.MAX_DOWNLOAD_BYTES)
+            f.write(b"x")
+        status, _, _ = await asyncio.to_thread(get, "/files/big.bin")
+        assert status == 413
         await server.stop()
         stask.cancel()
 

@@ -60,7 +60,10 @@ def test_index_wires_modules():
     html = read("index.html")
     assert "selkies-client.js" in html
     assert "input.js" in html
-    assert "SelkiesClient" in html and "SelkiesInput" in html
+    assert "dashboard.js" in html
+    # client/input construction moved into the dashboard layer
+    dash = read("dashboard.js")
+    assert "new SelkiesClient" in dash and "new SelkiesInput" in dash
 
 
 def test_web_root_served_over_http():
@@ -121,3 +124,98 @@ def test_touch_gamepad_contract():
     assert "getGamepads" in js
     assert "gamepadconnected" in js and "gamepaddisconnected" in js
     assert '"standard"' in js     # mapping: standard-gamepad layout
+
+
+# ------------------------------------------------------------ syntax lint
+# No JS runtime exists in this image (no node/bun/quickjs, no browser), so
+# the client cannot be executed here; tools/jscheck.py is the strongest
+# automatic gate available — a string/template/regex-aware tokenizer with
+# bracket balancing that fails CI on the typo classes that previously
+# could ship silently.
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(ROOT)
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def _jscheck(src: str):
+    import jscheck
+
+    return jscheck.check(src)
+
+
+def test_jscheck_accepts_all_bundled_js():
+    for path in sorted((REPO / "web").glob("*.js")):
+        toks = _jscheck(path.read_text())
+        assert len(toks) > 100, path
+
+
+def test_jscheck_catches_broken_js():
+    import jscheck
+    import pytest as _pytest
+
+    good = 'const x = { a: [1, 2], b: "s" }; f(`t ${x.a[0]} u`);'
+    jscheck.check(good)
+    for bad in (
+        'function f() { return 1; ',          # unclosed brace
+        'const s = "oops;',                   # unterminated string
+        'const t = `tpl ${1};',               # unterminated template
+        'if (a) { g(] }',                     # mismatched bracket
+        '/* never closed',                    # unterminated comment
+        'const r = /abc;',                    # unterminated regex
+    ):
+        with _pytest.raises(jscheck.JsSyntaxError):
+            jscheck.check(bad)
+
+
+def test_jscheck_regex_vs_division():
+    import jscheck
+
+    toks = jscheck.check('const a = b / c / d; const r = /x[/]y/g;')
+    kinds = [k for k, _, _ in toks]
+    assert "regex" in kinds
+    assert kinds.count("regex") == 1
+
+
+# ------------------------------------------------------- dashboard contract
+
+
+def test_dashboard_is_schema_driven():
+    src = (REPO / "web" / "dashboard.js").read_text()
+    # settings widgets render from the server_settings push, not a
+    # hardcoded list: bool/range/enum shapes all handled, locked honored
+    assert "onServerSettings" in src
+    assert "entry.locked" in src
+    assert '"min" in entry' in src and '"max" in entry' in src
+    assert "entry.allowed" in src
+    # changes round-trip to the server via a SETTINGS re-send
+    assert '"SETTINGS," + JSON.stringify' in src
+    assert "localStorage" in src
+
+
+def test_dashboard_covers_reference_sidebar_surface():
+    src = (REPO / "web" / "dashboard.js").read_text()
+    for needle in (
+        "_renderSharing",          # sharing links per enable_* flag
+        "#shared", "#player",
+        "_toggleFilesModal",       # download modal → ./files/
+        "./files/",
+        "uploadFile",
+        "cmd,",                    # apps launcher (command_enabled gated)
+        "_drawGamepads",           # gamepad visualizer
+        "getGamepads",
+        "requestFullscreen",
+        "requestPointerLock",
+        "startMicrophone",
+        "ui_sidebar_show_stats",   # server-driven UI gating
+        "ui_title",
+    ):
+        assert needle in src, needle
+
+
+def test_index_html_wires_dashboard():
+    src = (REPO / "web" / "index.html").read_text()
+    assert "dashboard.js" in src
+    assert "SelkiesDashboard" in src

