@@ -93,6 +93,25 @@ class OpusDecoder:
             raise RuntimeError(f"opus_decode error {n}")
         return self._buf[:n * self.channels].reshape(n, self.channels).copy()
 
+    def decode_fec(self, next_packet: bytes, frames: int) -> np.ndarray:
+        """Reconstruct a LOST frame from the in-band FEC data of the
+        packet that followed it. ``frames`` = the lost frame's duration
+        in samples/channel (960 for the 20 ms default)."""
+        data = np.frombuffer(next_packet, np.uint8)
+        n = self._lib.sa_dec_decode_fec(
+            self._h, np.ascontiguousarray(data), len(next_packet),
+            self._buf, frames)
+        if n < 0:
+            raise RuntimeError(f"opus_decode fec error {n}")
+        return self._buf[:n * self.channels].reshape(n, self.channels).copy()
+
+    def decode_plc(self, frames: int) -> np.ndarray:
+        """Packet-loss concealment when no FEC data is available."""
+        n = self._lib.sa_dec_plc(self._h, self._buf, frames)
+        if n < 0:
+            raise RuntimeError(f"opus plc error {n}")
+        return self._buf[:n * self.channels].reshape(n, self.channels).copy()
+
     def close(self) -> None:
         if self._h:
             self._lib.sa_dec_free(self._h)

@@ -130,6 +130,11 @@ def _register_audio(lib: ctypes.CDLL) -> None:
     lib.sa_dec_decode.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_int32,
                                   _i16p, ctypes.c_int]
     lib.sa_dec_decode.restype = ctypes.c_int
+    lib.sa_dec_decode_fec.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_int32,
+                                      _i16p, ctypes.c_int]
+    lib.sa_dec_decode_fec.restype = ctypes.c_int
+    lib.sa_dec_plc.argtypes = [ctypes.c_void_p, _i16p, ctypes.c_int]
+    lib.sa_dec_plc.restype = ctypes.c_int
     lib.sa_dec_free.argtypes = [ctypes.c_void_p]
     lib.sa_pa_new.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
                               ctypes.c_int, ctypes.c_char_p]

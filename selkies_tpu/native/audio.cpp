@@ -180,6 +180,23 @@ int sa_dec_decode(void *h, const uint8_t *data, int32_t size, int16_t *out,
     return api->decode((OpusDecoder *)h, data, size, out, max_frames, 0);
 }
 
+// In-band FEC recovery: reconstruct the LOST frame from the redundant
+// data embedded in the FOLLOWING packet (fec=1). max_frames must equal
+// the lost frame's duration (e.g. 960 for 20 ms @ 48 kHz).
+int sa_dec_decode_fec(void *h, const uint8_t *data, int32_t size,
+                      int16_t *out, int max_frames) {
+    OpusApi *api = opus_api();
+    if (!api || !h) return -1;
+    return api->decode((OpusDecoder *)h, data, size, out, max_frames, 1);
+}
+
+// Packet-loss concealment: synthesize max_frames samples with no packet.
+int sa_dec_plc(void *h, int16_t *out, int max_frames) {
+    OpusApi *api = opus_api();
+    if (!api || !h) return -1;
+    return api->decode((OpusDecoder *)h, nullptr, 0, out, max_frames, 0);
+}
+
 void sa_dec_free(void *h) {
     OpusApi *api = opus_api();
     if (api && h) api->decoder_destroy((OpusDecoder *)h);

@@ -181,6 +181,10 @@ class SignalingServer:
             return self._response(http.HTTPStatus.NOT_FOUND,
                                   b"file downloads disabled")
         rel = urllib.parse.unquote(path.split("?")[0][len("/files"):])
+        if "\x00" in rel:
+            # realpath raises ValueError on embedded NULs; hostile paths
+            # must 404, not 500
+            return self._response(http.HTTPStatus.NOT_FOUND, b"404 NOT FOUND")
         full = os.path.realpath(
             os.path.join(self.files_root, rel.lstrip("/")))
         if os.path.commonpath((self.files_root, full)) != self.files_root:

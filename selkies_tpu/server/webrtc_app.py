@@ -174,8 +174,11 @@ class WebRTCStreamingApp:
         await self.pc.wait_connected()
         settings = self.audio_settings
         src = open_source(settings)
+        # in-band FEC on the lossy (SRTP) path, like the reference's
+        # opusenc inband-fec=true (legacy/gstwebrtc_app.py:1048): the
+        # receiver recovers a lost 20 ms frame from the next packet
         enc = OpusEncoder(settings.sample_rate, settings.channels,
-                          settings.opus_bitrate)
+                          settings.opus_bitrate, inband_fec=True)
         frames = settings.sample_rate * FRAME_MS // 1000
         ts = 0
         try:

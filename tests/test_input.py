@@ -386,3 +386,45 @@ def test_png_encoder_valid():
     idat_len = struct.unpack(">I", png[idat_off - 8:idat_off - 4])[0]
     raw = zlib.decompress(png[idat_off:idat_off + idat_len])
     assert len(raw) == 4 * (1 + 16)
+
+
+# ---------------------------------------------------------------------------
+# international / IME coverage (VERDICT round-1 weakness 9)
+
+
+def test_cyrillic_keysym_reaches_backend():
+    h, be, _ = make_handler()
+    zhe = 0x01000000 | ord("Ж")      # client unicode rule for non-latin keys
+    run(h.on_message(f"kd,{zhe}"))
+    run(h.on_message(f"ku,{zhe}"))
+    # printable non-latin: atomically typed (stuck-modifier-safe), exactly
+    # like latin printables — never silently dropped
+    assert ("type", "Ж") in be.events or ("key", zhe, True) in be.events
+
+
+def test_cjk_ime_composition_types_atomically():
+    h, be, _ = make_handler()
+    run(h.on_message("co,end,こんにちは世界"))
+    assert ("type", "こんにちは世界") in be.events
+
+
+def test_dead_key_composed_character():
+    h, be, _ = make_handler()
+    run(h.on_message("co,end,é"))    # dead-acute + e composed client-side
+    assert ("type", "é") in be.events
+
+
+def test_xf86_media_keysym_not_dropped():
+    from selkies_tpu.input.keysyms import keysym_to_name
+
+    h, be, _ = make_handler()
+    vol_up = 0x1008ff13              # XF86AudioRaiseVolume
+    run(h.on_message(f"kd,{vol_up}"))
+    assert ("key", vol_up, True) in be.events
+    assert keysym_to_name(vol_up) is not None
+
+
+def test_keypad_keysyms_roundtrip():
+    h, be, _ = make_handler()
+    run(h.on_message("kd,65421"))    # KP_Enter 0xff8d
+    assert ("key", 0xff8d, True) in be.events

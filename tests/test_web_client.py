@@ -219,3 +219,32 @@ def test_index_html_wires_dashboard():
     src = (REPO / "web" / "index.html").read_text()
     assert "dashboard.js" in src
     assert "SelkiesDashboard" in src
+
+
+def test_input_ime_and_trackpad_surface():
+    js = read("input.js")
+    # composition/IME: hidden proxy, composition events → atomic typing
+    for needle in ("compositionstart", "compositionend", "co,end,",
+                   "isComposing", '"Dead"', "popKeyboard",
+                   "toggleTrackpadMode", "_touchTrackpad",
+                   "deleteContentBackward"):
+        assert needle in js, needle
+    # keypad + media keysyms present
+    for needle in ("NumpadEnter: 0xff8d", "AudioVolumeUp: 0x1008ff13",
+                   "Convert: 0xff21"):
+        assert needle in js, needle
+
+
+def test_input_js_lints():
+    _jscheck(read("input.js"))
+
+
+def test_client_audio_worklet_ring():
+    js = read("selkies-client.js")
+    for needle in ("AudioWorkletProcessor", "registerProcessor",
+                   "selkies-ring", "audioWorklet.addModule",
+                   "AudioWorkletNode", "this.jitter"):
+        assert needle in js, needle
+    # jitter floor + underrun rebuffering, not per-chunk scheduling only
+    assert "underrun" in js
+    assert "createBufferSource" in js      # fallback retained

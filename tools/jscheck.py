@@ -145,6 +145,12 @@ def tokenize(src: str) -> List[Tuple[str, str, int]]:
             push("punct", "=>", i)
             i += 2
             continue
+        if src.startswith("++", i) or src.startswith("--", i):
+            # postfix increment must not make a following "/" look like a
+            # regex start ("n++ / 2" is division)
+            push("punct", src[i:i + 2], i)
+            i += 2
+            continue
         if c in PUNCT:
             push("punct", c, i)
             i += 1

@@ -172,6 +172,10 @@ class SelkiesDashboard {
   _renderSettingSections() {
     this.settingsHost.textContent = "";
     this.widgets.clear();
+    if (this._gamepadTimer) {     // old sidebar's draw loop dies with it
+      clearInterval(this._gamepadTimer);
+      this._gamepadTimer = null;
+    }
     const used = new Set();
     for (const [title, gate, names] of SelkiesDashboard.SECTIONS) {
       names.forEach((n) => used.add(n));

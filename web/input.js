@@ -3,10 +3,12 @@
  *
  * Role parity with the reference's addons/gst-web-core/lib/input.js
  * (Guacamole-derived, 2,505 LoC): keyboard → X11 keysyms ("kd,"/"ku,"),
- * pointer/touch → "m," absolute / "m2," relative (pointer lock), wheel,
- * gamepad polling → "js,c/b/a/d" messages. Fresh, compact implementation:
- * printable keys map through the X11 rule (latin-1 keysym = codepoint,
- * others 0x01000000+codepoint); non-printables through an explicit table.
+ * composition/IME and dead keys → atomic "co,end,<text>" typing (the
+ * server binds spare keycodes for any codepoint), on-screen keyboard
+ * trigger, pointer/touch → "m," absolute / "m2," relative (pointer lock),
+ * touch-trackpad mode, wheel, gamepad polling → "js,c/b/a/d" messages.
+ * Printable keys map through the X11 rule (latin-1 keysym = codepoint,
+ * others 0x01000000+codepoint); non-printables through explicit tables.
  */
 
 "use strict";
@@ -22,20 +24,38 @@ const KEY_TO_KEYSYM = {
   Alt: 0xffe9, AltGraph: 0xffea, Meta: 0xffe7, CapsLock: 0xffe5,
   NumLock: 0xff7f, ScrollLock: 0xff14, Pause: 0xff13,
   PrintScreen: 0xff61, ContextMenu: 0xff67,
+  // IME control keys (reference lib/input.js keysym tables)
+  Convert: 0xff21, NonConvert: 0xff22, KanaMode: 0xff2d,
+  HiraganaKatakana: 0xff27, ZenkakuHankaku: 0xff2a,
+  HangulMode: 0xff31, HanjaMode: 0xff34,
+  // media / XF86 keys
+  AudioVolumeMute: 0x1008ff12, AudioVolumeDown: 0x1008ff11,
+  AudioVolumeUp: 0x1008ff13, MediaPlayPause: 0x1008ff14,
+  MediaStop: 0x1008ff15, MediaTrackPrevious: 0x1008ff16,
+  MediaTrackNext: 0x1008ff17, BrowserBack: 0x1008ff26,
+  BrowserForward: 0x1008ff27, BrowserRefresh: 0x1008ff29,
+  BrowserHome: 0x1008ff18,
 };
 
-const CODE_TO_KEYSYM_RIGHT = {
+const CODE_TO_KEYSYM = {
   ShiftRight: 0xffe2, ControlRight: 0xffe4, AltRight: 0xffea,
   MetaRight: 0xffe8,
+  // keypad: ev.key reports the printable digit/operator, but X apps
+  // distinguish KP_* keysyms (NumLock handling, games)
+  NumpadEnter: 0xff8d, NumpadDivide: 0xffaf, NumpadMultiply: 0xffaa,
+  NumpadSubtract: 0xffad, NumpadAdd: 0xffab, NumpadDecimal: 0xffae,
+  Numpad0: 0xffb0, Numpad1: 0xffb1, Numpad2: 0xffb2, Numpad3: 0xffb3,
+  Numpad4: 0xffb4, Numpad5: 0xffb5, Numpad6: 0xffb6, Numpad7: 0xffb7,
+  Numpad8: 0xffb8, Numpad9: 0xffb9,
 };
 
 function eventKeysym(ev) {
+  if (ev.code in CODE_TO_KEYSYM) return CODE_TO_KEYSYM[ev.code];
   if (ev.key && ev.key.length === 1) {
     const cp = ev.key.codePointAt(0);
     if (cp < 0x100) return cp;                  // latin-1 direct
     return 0x01000000 + cp;                     // X11 unicode rule
   }
-  if (ev.code in CODE_TO_KEYSYM_RIGHT) return CODE_TO_KEYSYM_RIGHT[ev.code];
   if (ev.key in KEY_TO_KEYSYM) return KEY_TO_KEYSYM[ev.key];
   return null;
 }
@@ -50,6 +70,56 @@ class SelkiesInput {
     this.gamepadState = new Map();   // index -> {buttons:[], axes:[]}
     this.gamepadIndexOffset = 0;     // player2-4 sharing: remap pad slot
     this._handlers = [];
+    this.composing = false;
+    this.trackpadMode = false;
+    this._trackpad = { lastX: 0, lastY: 0, moved: 0, downAt: 0,
+                       fingers: 0 };
+    this._imeProxy = null;
+  }
+
+  /* Hidden text field hosting IME composition and summoning the mobile
+     on-screen keyboard: dead keys and CJK input only produce composition
+     events when an editable element has focus (reference lib/input.js
+     composition handling). */
+  _makeImeProxy() {
+    const t = document.createElement("textarea");
+    t.setAttribute("autocapitalize", "off");
+    t.setAttribute("autocomplete", "off");
+    t.setAttribute("spellcheck", "false");
+    t.style.cssText = "position:fixed;left:-1000px;top:0;width:1px;" +
+      "height:1px;opacity:0;z-index:-1;";
+    document.body.appendChild(t);
+    this._on(t, "compositionstart", () => { this.composing = true; });
+    this._on(t, "compositionend", (ev) => {
+      this.composing = false;
+      if (ev.data) this.client.send("co,end," + ev.data);
+      t.value = "";
+    });
+    this._on(t, "input", (ev) => {
+      // mobile keyboards often emit no usable key events: text arrives
+      // only here. Composition text is handled by compositionend.
+      if (this.composing) return;
+      if (ev.inputType === "insertText" && ev.data && !this._sentKey) {
+        this.client.send("co,end," + ev.data);
+      }
+      if (ev.inputType === "deleteContentBackward" && !this._sentKey) {
+        this.client.send("kd,65288");   // Backspace keysym 0xff08
+        this.client.send("ku,65288");
+      }
+      t.value = "";
+      this._sentKey = false;
+    });
+    return t;
+  }
+
+  popKeyboard() {
+    if (!this._imeProxy) this._imeProxy = this._makeImeProxy();
+    this._imeProxy.focus();
+  }
+
+  toggleTrackpadMode() {
+    this.trackpadMode = !this.trackpadMode;
+    return this.trackpadMode;
   }
 
   _on(target, type, fn, opts) {
@@ -67,11 +137,16 @@ class SelkiesInput {
 
   attach() {
     const on = (target, type, fn, opts) => this._on(target, type, fn, opts);
+    if (!this._imeProxy) this._imeProxy = this._makeImeProxy();
     on(window, "keydown", (e) => this._key(e, true));
     on(window, "keyup", (e) => this._key(e, false));
     on(window, "blur", () => this.client.send("kr"));
     on(this.el, "mousemove", (e) => this._motion(e));
-    on(this.el, "mousedown", (e) => this._button(e, true));
+    on(this.el, "mousedown", (e) => {
+      this._button(e, true);
+      // keep an editable element focused so dead keys / IME compose
+      this._imeProxy.focus({ preventScroll: true });
+    });
     on(this.el, "mouseup", (e) => this._button(e, false));
     on(this.el, "wheel", (e) => this._wheel(e), { passive: false });
     on(this.el, "contextmenu", (e) => e.preventDefault());
@@ -91,6 +166,10 @@ class SelkiesInput {
     }
     this._handlers = [];
     if (this.gamepadTimer) clearInterval(this.gamepadTimer);
+    if (this._imeProxy) {
+      this._imeProxy.remove();
+      this._imeProxy = null;
+    }
   }
 
   requestPointerLock() { this.el.requestPointerLock(); }
@@ -98,9 +177,17 @@ class SelkiesInput {
   /* -------------------------------------------------------- keyboard */
 
   _key(ev, down) {
+    // IME in progress: the composed string arrives via compositionend
+    // (keydown during composition reports keyCode 229 / isComposing)
+    if (ev.isComposing || ev.keyCode === 229 ||
+        ev.key === "Process" || ev.key === "Dead" ||
+        ev.key === "Unidentified") {
+      return;
+    }
     const keysym = eventKeysym(ev);
     if (keysym === null) return;
     ev.preventDefault();
+    this._sentKey = true;   // suppress the ime-proxy "input" fallback
     this.client.send((down ? "kd," : "ku,") + keysym);
   }
 
@@ -131,9 +218,16 @@ class SelkiesInput {
     this._motion(ev);
   }
 
-  /* Single-touch maps to a left-button drag (reference touch mode). */
+  /* Direct mode: single-touch maps to a left-button drag at the touch
+     point. Trackpad mode: the canvas becomes a laptop touchpad — one
+     finger moves the remote pointer relatively, a quick tap clicks,
+     two-finger vertical drag scrolls (reference trackpad touch mode). */
   _touch(ev, down) {
     ev.preventDefault();
+    if (this.trackpadMode) {
+      this._touchTrackpad(ev, down);
+      return;
+    }
     // on lift, report the finger that left; only release the button once
     // no touches remain (a brushing second finger must not break a drag)
     const t = down ? ev.touches[0] : ev.changedTouches[0];
@@ -142,6 +236,61 @@ class SelkiesInput {
     if (down) this.buttonMask |= 1;
     else if (ev.touches.length === 0) this.buttonMask &= ~1;
     this.client.send(`m,${x},${y},${this.buttonMask},0`);
+  }
+
+  _touchTrackpad(ev, down) {
+    const tp = this._trackpad;
+    const t = ev.touches[0];
+    if (ev.type === "touchstart") {
+      tp.fingers = ev.touches.length;
+      tp.lastX = t.clientX;
+      tp.lastY = t.clientY;
+      if (tp.fingers === 1) {
+        tp.moved = 0;
+        tp.downAt = performance.now();
+      }
+      return;
+    }
+    if (ev.type === "touchmove" && t) {
+      const dx = t.clientX - tp.lastX;
+      const dy = t.clientY - tp.lastY;
+      tp.lastX = t.clientX;
+      tp.lastY = t.clientY;
+      tp.moved += Math.abs(dx) + Math.abs(dy);
+      tp.fingers = Math.max(tp.fingers, ev.touches.length);
+      if (ev.touches.length >= 2) {
+        // two-finger scroll: wheel events at ~20 px per notch
+        tp.scrollAcc = (tp.scrollAcc || 0) + dy;
+        while (Math.abs(tp.scrollAcc) >= 20) {
+          const bit = tp.scrollAcc > 0 ? 8 : 16;   // natural scrolling
+          this.client.send(`m2,0,0,${this.buttonMask | bit},1`);
+          tp.scrollAcc -= Math.sign(tp.scrollAcc) * 20;
+        }
+      } else {
+        this.client.send(
+          `m2,${Math.round(dx * 1.5)},${Math.round(dy * 1.5)},` +
+          `${this.buttonMask},0`);
+      }
+      return;
+    }
+    // touchend / touchcancel
+    if (ev.touches.length === 0) {
+      const quick = performance.now() - tp.downAt < 250;
+      if (quick && tp.moved < 8) {
+        // tap → click; two-finger tap → right click
+        const btn = tp.fingers >= 2 ? 4 : 1;
+        this.client.send(`m2,0,0,${this.buttonMask | btn},0`);
+        this.client.send(`m2,0,0,${this.buttonMask},0`);
+      }
+      tp.fingers = 0;
+      tp.scrollAcc = 0;
+    } else {
+      // a finger lifted but others remain: re-baseline on the survivor so
+      // the next move doesn't jump by the inter-finger distance
+      tp.lastX = ev.touches[0].clientX;
+      tp.lastY = ev.touches[0].clientY;
+      tp.fingers = ev.touches.length;
+    }
   }
 
   _wheel(ev) {

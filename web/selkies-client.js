@@ -271,16 +271,88 @@ class SelkiesClient {
 
   /* ----------------------------------------------------------- audio */
 
-  async _ensureAudio() {
-    if (this.audioCtx) return;
-    this.audioCtx = new AudioContext({ sampleRate: 48000 });
-    this.audioDecoder = new AudioDecoder({
-      output: (audioData) => this._playAudio(audioData),
-      error: (e) => console.warn("AudioDecoder error", e),
-    });
-    this.audioDecoder.configure({
-      codec: "opus", sampleRate: 48000, numberOfChannels: 2,
-    });
+  /* AudioWorklet ring processor (reference selkies-core.js:2360-2460):
+     decoded PCM lands in a ring buffer drained by the audio render
+     thread; a ~40 ms jitter threshold absorbs network/decode timing
+     wobble that the old per-chunk createBufferSource scheduling turned
+     into audible glitches. */
+  static AUDIO_WORKLET = `
+    class SelkiesRing extends AudioWorkletProcessor {
+      constructor() {
+        super();
+        this.cap = 48000;                       // 1 s per channel
+        this.ring = [new Float32Array(this.cap),
+                     new Float32Array(this.cap)];
+        this.w = 0; this.r = 0; this.started = false;
+        this.jitter = 1920;                     // 40 ms @ 48 kHz
+        this.port.onmessage = (ev) => {
+          const { ch0, ch1 } = ev.data;
+          for (let i = 0; i < ch0.length; i++) {
+            const p = this.w % this.cap;
+            this.ring[0][p] = ch0[i];
+            this.ring[1][p] = (ch1 || ch0)[i];
+            this.w++;
+          }
+          // overrun: drop the oldest (reader too slow / tab throttled)
+          if (this.w - this.r > this.cap) this.r = this.w - this.cap;
+        };
+      }
+      process(inputs, outputs) {
+        const out = outputs[0];
+        const avail = this.w - this.r;
+        if (!this.started) {
+          if (avail < this.jitter) return true;  // build the jitter floor
+          this.started = true;
+        }
+        if (avail < out[0].length) {
+          this.started = false;                  // underrun: rebuffer
+          return true;
+        }
+        for (let i = 0; i < out[0].length; i++) {
+          const p = this.r % this.cap;
+          out[0][i] = this.ring[0][p];
+          if (out[1]) out[1][i] = this.ring[1][p];
+          this.r++;
+        }
+        return true;
+      }
+    }
+    registerProcessor("selkies-ring", SelkiesRing);`;
+
+  _ensureAudio() {
+    // single-flight init: concurrent _onAudio calls await the same setup,
+    // and a worklet failure degrades to the per-chunk fallback instead of
+    // leaving audio permanently dead (e.g. CSP without blob: scripts)
+    if (this._audioInit) return this._audioInit;
+    this._audioInit = (async () => {
+      this.audioCtx = new AudioContext({ sampleRate: 48000 });
+      try {
+        if (this.audioCtx.audioWorklet) {
+          const url = URL.createObjectURL(new Blob(
+            [SelkiesClient.AUDIO_WORKLET],
+            { type: "application/javascript" }));
+          try {
+            await this.audioCtx.audioWorklet.addModule(url);
+          } finally {
+            URL.revokeObjectURL(url);
+          }
+          this.audioNode = new AudioWorkletNode(
+            this.audioCtx, "selkies-ring", { outputChannelCount: [2] });
+          this.audioNode.connect(this.audioCtx.destination);
+        }
+      } catch (e) {
+        console.warn("AudioWorklet unavailable; per-chunk fallback", e);
+        this.audioNode = null;
+      }
+      this.audioDecoder = new AudioDecoder({
+        output: (audioData) => this._playAudio(audioData),
+        error: (e) => console.warn("AudioDecoder error", e),
+      });
+      this.audioDecoder.configure({
+        codec: "opus", sampleRate: 48000, numberOfChannels: 2,
+      });
+    })();
+    return this._audioInit;
   }
 
   async _onAudio(data) {
@@ -295,6 +367,21 @@ class SelkiesClient {
   }
 
   _playAudio(audioData) {
+    if (this.audioNode) {
+      const n = audioData.numberOfFrames;
+      const ch0 = new Float32Array(n);
+      audioData.copyTo(ch0, { planeIndex: 0, format: "f32-planar" });
+      let ch1 = ch0;
+      if (audioData.numberOfChannels > 1) {
+        ch1 = new Float32Array(n);
+        audioData.copyTo(ch1, { planeIndex: 1, format: "f32-planar" });
+      }
+      audioData.close();
+      this.audioNode.port.postMessage({ ch0, ch1 },
+        ch1 === ch0 ? [ch0.buffer] : [ch0.buffer, ch1.buffer]);
+      return;
+    }
+    // fallback path: per-chunk scheduling (no AudioWorklet support)
     const ctx = this.audioCtx;
     const buf = ctx.createBuffer(
       audioData.numberOfChannels, audioData.numberOfFrames, 48000);
