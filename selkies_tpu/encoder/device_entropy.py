@@ -136,12 +136,19 @@ def _lut512(idx_flat):
 
     ``jnp.take`` gathers cost ~10 ns/element on the TPU scalar core (~25 ms
     at 3.1M lookups); routing the same lookup through the MXU costs ~2 ms.
-    Values are ≤ 2^21 so float32 arithmetic is exact.
+    Values are ≤ 2^21 so float32 arithmetic is exact — but ONLY at
+    ``Precision.HIGHEST``: the TPU MXU's default f32 path rounds operands
+    to bf16 (8 mantissa bits), which silently corrupts the packed
+    code/len table and with it the whole bitstream. (Found driving the
+    encoder on a real v5e chip; CPU/GPU backends mask the bug because
+    their f32 matmuls are true f32.)
     """
     table = _packed_ac_tables().reshape(32, 16)
     hi = idx_flat >> 4
     lo = idx_flat & 15
-    rows = jax.nn.one_hot(hi, 32, dtype=jnp.float32) @ jnp.asarray(table)
+    rows = jnp.dot(jax.nn.one_hot(hi, 32, dtype=jnp.float32),
+                   jnp.asarray(table),
+                   precision=jax.lax.Precision.HIGHEST)
     picked = (rows * jax.nn.one_hot(lo, 16, dtype=jnp.float32)).sum(-1)
     return picked.astype(jnp.int32)
 

@@ -49,9 +49,14 @@ def _block_diag_c8t() -> np.ndarray:
 
 
 def _tile_kernel(x_ref, recip_ref, c8_ref, bd_ref, out_ref):
+    # HIGHEST precision: the MXU's default f32 path rounds operands to
+    # bf16, which shifts rounded coefficients near quantization boundaries
+    # (same hazard ops/dct.py pins against).
     x = x_ref[:] - 128.0
-    v = jnp.dot(c8_ref[:], x, preferred_element_type=jnp.float32)
-    y = jnp.dot(v, bd_ref[:], preferred_element_type=jnp.float32)
+    v = jnp.dot(c8_ref[:], x, preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+    y = jnp.dot(v, bd_ref[:], preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
     out_ref[:] = jnp.round(y * recip_ref[0])
 
 

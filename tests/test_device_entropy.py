@@ -85,3 +85,38 @@ def test_all_zero_blocks():
 def test_stuff_bytes():
     assert stuff_bytes(b"\xff\x00\xff") == b"\xff\x00\x00\xff\x00"
     assert stuff_bytes(b"abc") == b"abc"
+
+
+def test_no_default_precision_f32_matmuls_in_pack_graph():
+    """MXU-precision canary: the TPU lowers DEFAULT-precision f32
+    dot_generals to bf16 operand rounding, which silently corrupts the
+    packed Huffman table (found on a real v5e: stripes decoded at ~10 dB
+    while every CPU test passed). CPU runs can't reproduce that rounding,
+    so instead assert structurally that every floating dot in the pack
+    graph pins Precision.HIGHEST."""
+    import jax
+    import jax.numpy as jnp
+
+    packer = DeviceEntropyPacker(32, 32, 32)
+    yq = jnp.zeros((4, 4, 64), jnp.int16)
+    cq = jnp.zeros((2, 2, 64), jnp.int16)
+    jaxpr = jax.make_jaxpr(packer._pack_fn)(yq, cq, cq)
+
+    def walk(jx, out):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "dot_general":
+                out.append(eqn)
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr, out)
+        return out
+
+    dots = walk(jaxpr.jaxpr, [])
+    assert dots, "expected at least the _lut512 one-hot matmul"
+    for eqn in dots:
+        if any(jnp.issubdtype(v.aval.dtype, jnp.floating)
+               for v in eqn.invars):
+            prec = eqn.params.get("precision")
+            assert prec is not None and "HIGHEST" in str(prec), (
+                f"f32 dot_general with default precision in pack graph: "
+                f"{eqn.params}")
