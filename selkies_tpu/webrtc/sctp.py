@@ -13,9 +13,12 @@ Subset implemented (sufficient for browser data channels):
   - DCEP DATA_CHANNEL_OPEN / ACK (PPID 50) and string (51) / binary (53)
     payloads; empty-string (56) / empty-binary (57) map to b"".
 
-There is no congestion window: desktop-streaming input channels move tiny
-messages (media rides SRTP, not SCTP), and RTO-based retransmission with
-endpoint-failure abort bounds the in-flight set.
+Congestion control (RFC 4960 §7): a per-association cwnd with slow start
+and congestion avoidance gates the DATA send path, so a data channel can
+carry bulk payloads (file transfers) without flooding the path; SACK gap
+reports drive fast retransmit (ssthresh = cwnd/2), and a T3-RTO collapses
+cwnd to one MTU. Sends beyond min(cwnd, peer rwnd) queue in order and
+drain on SACK arrival or from ``check_retransmit``.
 """
 
 from __future__ import annotations
@@ -127,6 +130,8 @@ class _OutChunk:
     data: bytes                 # full DATA chunk bytes
     sent_at: float
     retransmits: int = 0
+    missed: int = 0             # SACK rounds this TSN was reported missing
+    fast_rtxed: bool = False
 
 
 class SctpAssociation:
@@ -160,6 +165,14 @@ class SctpAssociation:
         # collide across messages
         self._u_reasm: Dict[int, Dict[int, Tuple[bool, bool, int, bytes]]] = {}
         self._out: Dict[int, _OutChunk] = {}
+        self._queue: List[_OutChunk] = []   # cwnd-gated, FIFO by TSN
+        # RFC 4960 §7.2.1 initial cwnd; ssthresh starts at the peer's
+        # advertised window (updated from every SACK)
+        self.cwnd = min(4 * MTU, max(2 * MTU, 4380))
+        self.ssthresh = 1 << 20
+        self.peer_rwnd = 1 << 20
+        self.flight = 0                     # DATA chunk bytes outstanding
+        self._partial_bytes_acked = 0
         self._recv_tsns: set = set()
         self._next_even_odd = 0 if is_client else 1
         self._setup_chunk: Optional[Tuple[bytes, int]] = None  # (chunk, vtag)
@@ -218,6 +231,7 @@ class SctpAssociation:
             chunk, vtag = self._setup_chunk
             self._setup_sent_at = now
             self._send_packet([chunk], vtag=vtag)
+        rto_fired = False
         for chunk in list(self._out.values()):
             if now - chunk.sent_at > RTO * (2 ** min(chunk.retransmits, 4)):
                 chunk.retransmits += 1
@@ -230,8 +244,17 @@ class SctpAssociation:
                                  chunk.retransmits)
                     self.state = "closed"
                     self._out.clear()
+                    self._queue.clear()
+                    self.flight = 0
                     return
+                rto_fired = True
                 self._send_packet([chunk.data])
+        if rto_fired:
+            # RFC 4960 §7.2.3: T3-rtx collapses cwnd to one MTU
+            self.ssthresh = max(self.cwnd // 2, 4 * MTU)
+            self.cwnd = MTU
+            self._partial_bytes_acked = 0
+        self._flush(now)
 
     # ----------------------------------------------------------- receive
 
@@ -347,8 +370,26 @@ class SctpAssociation:
             self.next_tsn = (self.next_tsn + 1) & 0xFFFFFFFF
             body = struct.pack("!IHHI", tsn, sid, ssn, ppid) + piece
             chunk = self._chunk(CT_DATA, flags, body)
-            self._out[tsn] = _OutChunk(tsn, chunk, time.monotonic())
-            self._send_packet([chunk])
+            self._queue.append(_OutChunk(tsn, chunk, 0.0))
+        self._flush()
+
+    def _flush(self, now: Optional[float] = None) -> None:
+        """Send queued DATA while the flight fits min(cwnd, peer rwnd).
+
+        One chunk is always allowed when nothing is in flight (the
+        zero-window probe of RFC 4960 §6.1 A), so the association cannot
+        deadlock on a zero advertisement."""
+        window = min(self.cwnd, self.peer_rwnd)
+        while self._queue:
+            chunk = self._queue[0]
+            size = len(chunk.data)
+            if self.flight > 0 and self.flight + size > window:
+                return
+            self._queue.pop(0)
+            chunk.sent_at = time.monotonic() if now is None else now
+            self._out[chunk.tsn] = chunk
+            self.flight += size
+            self._send_packet([chunk.data])
 
     def _on_data(self, flags: int, body: bytes) -> None:
         if len(body) < 12:
@@ -547,17 +588,61 @@ class SctpAssociation:
         if len(body) < 12:
             return
         cum, rwnd, n_gaps, n_dups = struct.unpack_from("!IIHH", body)
+        self.peer_rwnd = rwnd
+        acked_bytes = 0
+
+        def _ack(tsn: int) -> None:
+            nonlocal acked_bytes
+            chunk = self._out.pop(tsn, None)
+            if chunk is not None:
+                acked_bytes += len(chunk.data)
+                self.flight = max(0, self.flight - len(chunk.data))
+
         for tsn in list(self._out):
             if not tsn_gt(tsn, cum):
-                del self._out[tsn]
+                _ack(tsn)
         pos = 12
+        gap_acked: set = set()
+        highest = cum
         for _ in range(n_gaps):
             if pos + 4 > len(body):
                 break
             s, e = struct.unpack_from("!HH", body, pos)
             pos += 4
             for off in range(s, e + 1):
-                self._out.pop((cum + off) & 0xFFFFFFFF, None)
+                t = (cum + off) & 0xFFFFFFFF
+                gap_acked.add(t)
+                if tsn_gt(t, highest):
+                    highest = t
+                _ack(t)
+        if acked_bytes:
+            if self.cwnd <= self.ssthresh:
+                # slow start: at most one MTU per SACK that acks new data
+                self.cwnd += min(acked_bytes, MTU)
+            else:
+                # congestion avoidance: one MTU per cwnd of acked bytes
+                self._partial_bytes_acked += acked_bytes
+                if self._partial_bytes_acked >= self.cwnd:
+                    self._partial_bytes_acked -= self.cwnd
+                    self.cwnd += MTU
+        # fast retransmit (RFC 4960 §7.2.4): a TSN below the highest
+        # gap-acked TSN reported missing by 3 SACKs goes out immediately,
+        # once, with multiplicative decrease
+        fast_rtx = False
+        if gap_acked:
+            for tsn, chunk in self._out.items():
+                if tsn_gt(highest, tsn) and tsn not in gap_acked:
+                    chunk.missed += 1
+                    if chunk.missed >= 3 and not chunk.fast_rtxed:
+                        chunk.fast_rtxed = True
+                        chunk.sent_at = time.monotonic()
+                        self._send_packet([chunk.data])
+                        fast_rtx = True
+        if fast_rtx:
+            self.ssthresh = max(self.cwnd // 2, 4 * MTU)
+            self.cwnd = self.ssthresh
+            self._partial_bytes_acked = 0
+        self._flush()
 
     # ------------------------------------------------------------- DCEP
 

@@ -8,8 +8,9 @@ import random
 
 import pytest
 
-from selkies_tpu.webrtc.sctp import (DataChannel, SctpAssociation, crc32c,
-                                     crc32c_fast, tsn_gt)
+from selkies_tpu.webrtc.sctp import (MTU as MTU_BYTES, DataChannel,
+                                     SctpAssociation, crc32c, crc32c_fast,
+                                     tsn_gt)
 
 
 def pump(a, b, qa, qb, drop=None, iters=400):
@@ -335,3 +336,73 @@ def test_forward_tsn_prunes_unordered_fragments():
         b.receive(qa.pop(0))
     assert not b._u_reasm[ch.stream_id]  # abandoned fragments freed
     assert got == []
+
+
+def _established_pair():
+    a, b, qa, qb = make_pair()
+    b.start()
+    a.start()
+    pump(a, b, qa, qb)
+    ch = a.create_channel("bulk")
+    pump(a, b, qa, qb)
+    return a, b, qa, qb, ch
+
+
+def test_cwnd_gates_bulk_send():
+    """RFC 4960 §7: a bulk send must not dump the whole message on the wire
+    — only ~cwnd bytes leave, the rest queue and drain on SACKs."""
+    a, b, qa, qb, ch = _established_pair()
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+    blob = bytes(range(256)) * 800          # ~200 KB, ~180 fragments
+    a.send(ch, blob)
+    assert a._queue                          # not everything went out
+    assert a.flight <= a.cwnd + MTU_BYTES
+    on_wire = sum(len(p) for p in qa)
+    assert on_wire < len(blob) // 2
+    start_cwnd = a.cwnd
+    pump(a, b, qa, qb, iters=5000)
+    assert got == [blob]
+    assert a.flight == 0 and not a._queue
+    assert a.cwnd > start_cwnd               # slow start grew the window
+
+
+def test_fast_retransmit_on_three_gap_reports():
+    a, b, qa, qb, ch = _established_pair()
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+    a.cwnd = 50_000      # a grown window, so the 4*MTU ssthresh floor
+    msgs = [b"m%d" % i for i in range(5)]   # doesn't mask the decrease
+    for m in msgs:
+        a.send(ch, m)
+    pkts = [qa.pop(0) for _ in range(5)]
+    cwnd_before = a.cwnd
+    for p in pkts[1:]:                       # first DATA packet is lost
+        b.receive(p)
+        a.receive(qb.pop(0))                 # its gap-reporting SACK
+    # the third missing report triggers fast retransmit without any timer
+    assert qa, "fast retransmit did not fire"
+    assert a.cwnd < cwnd_before              # multiplicative decrease
+    while qa:
+        b.receive(qa.pop(0))
+    while qb:
+        a.receive(qb.pop(0))
+    assert got == msgs                       # ordered delivery preserved
+
+
+def test_rto_collapses_cwnd_to_one_mtu():
+    a, b, qa, qb, ch = _established_pair()
+    a.cwnd = 50_000
+    a.send(ch, b"probe")
+    qa.clear()                               # lose it
+    a.check_retransmit(now=1e9)
+    assert a.cwnd == MTU_BYTES
+    assert a.ssthresh >= 4 * MTU_BYTES
+
+
+def test_flight_accounting_on_ack():
+    a, b, qa, qb, ch = _established_pair()
+    a.send(ch, b"x" * 4000)
+    assert a.flight > 0
+    pump(a, b, qa, qb)
+    assert a.flight == 0 and not a._out
