@@ -21,9 +21,29 @@ logger = logging.getLogger(__name__)
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def _compile_lib(src: str, so: str, extra: tuple = ()) -> bool:
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", so, src,
-           *extra]
+#: Set SELKIES_NATIVE_SANITIZE=address|thread|undefined to build every
+#: native lib with the matching -fsanitize instrumentation (the sanitized
+#: .so is cached under a distinct name, so it never shadows the production
+#: build). Load the matching runtime first, e.g.
+#: ``LD_PRELOAD=$(g++ -print-file-name=libasan.so)`` for address.
+_SANITIZE_ENV = "SELKIES_NATIVE_SANITIZE"
+
+
+def _sanitize_mode() -> str:
+    mode = os.environ.get(_SANITIZE_ENV, "").strip()
+    if mode and mode not in ("address", "thread", "undefined"):
+        logger.warning("%s=%r not one of address|thread|undefined; ignored",
+                       _SANITIZE_ENV, mode)
+        return ""
+    return mode
+
+
+def _compile_lib(src: str, so: str, extra: tuple = (),
+                 sanitize: str = "") -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", so, src]
+    if sanitize:
+        cmd += [f"-fsanitize={sanitize}", "-g", "-fno-omit-frame-pointer"]
+    cmd += list(extra)
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
@@ -47,7 +67,12 @@ class _LazyLib:
     def __init__(self, name: str, extra: tuple = (),
                  register: Optional[Callable] = None) -> None:
         self.src = os.path.join(_DIR, name + ".cpp")
-        self.so = os.path.join(_DIR, f"_libselkies_{name}.so")
+        # resolved once so the flags and the cache filename can't diverge
+        # (an env-var change after import must not write an instrumented
+        # binary under the production .so name)
+        self.sanitize = _sanitize_mode()
+        suffix = f"_{self.sanitize}" if self.sanitize else ""
+        self.so = os.path.join(_DIR, f"_libselkies_{name}{suffix}.so")
         self.extra = extra
         self.register = register
         self._lock = threading.Lock()
@@ -60,7 +85,7 @@ class _LazyLib:
                 return self._lib
             self._tried = True
             if _stale(self.so, self.src) and not _compile_lib(
-                    self.src, self.so, self.extra):
+                    self.src, self.so, self.extra, sanitize=self.sanitize):
                 return None
             try:
                 lib = ctypes.CDLL(self.so)
