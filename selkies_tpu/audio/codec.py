@@ -97,6 +97,7 @@ class OpusDecoder:
         """Reconstruct a LOST frame from the in-band FEC data of the
         packet that followed it. ``frames`` = the lost frame's duration
         in samples/channel (960 for the 20 ms default)."""
+        frames = min(int(frames), self._buf.size // self.channels)
         data = np.frombuffer(next_packet, np.uint8)
         n = self._lib.sa_dec_decode_fec(
             self._h, np.ascontiguousarray(data), len(next_packet),
@@ -107,6 +108,7 @@ class OpusDecoder:
 
     def decode_plc(self, frames: int) -> np.ndarray:
         """Packet-loss concealment when no FEC data is available."""
+        frames = min(int(frames), self._buf.size // self.channels)
         n = self._lib.sa_dec_plc(self._h, self._buf, frames)
         if n < 0:
             raise RuntimeError(f"opus plc error {n}")

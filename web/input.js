@@ -177,6 +177,13 @@ class SelkiesInput {
   /* -------------------------------------------------------- keyboard */
 
   _key(ev, down) {
+    // _sentKey mirrors "was the most recent key event handled here?" —
+    // reset on EVERY key event, set only when a keysym is sent. The
+    // ime-proxy "input" event always follows its causal keydown, so this
+    // is exactly the suppression it needs; a latched flag (cleared only
+    // in the input handler) would swallow the first OSK character after
+    // an Enter/Backspace, whose preventDefault'ed keydown fires no input.
+    this._sentKey = false;
     // IME in progress: the composed string arrives via compositionend
     // (keydown during composition reports keyCode 229 / isComposing)
     if (ev.isComposing || ev.keyCode === 229 ||
@@ -187,7 +194,7 @@ class SelkiesInput {
     const keysym = eventKeysym(ev);
     if (keysym === null) return;
     ev.preventDefault();
-    this._sentKey = true;   // suppress the ime-proxy "input" fallback
+    this._sentKey = true;
     this.client.send((down ? "kd," : "ku,") + keysym);
   }
 
@@ -259,11 +266,14 @@ class SelkiesInput {
       tp.moved += Math.abs(dx) + Math.abs(dy);
       tp.fingers = Math.max(tp.fingers, ev.touches.length);
       if (ev.touches.length >= 2) {
-        // two-finger scroll: wheel events at ~20 px per notch
+        // two-finger scroll: wheel events at ~20 px per notch. The server
+        // acts on mask EDGES, so each notch must be a press/release pair —
+        // a held scroll bit would latch after the first notch.
         tp.scrollAcc = (tp.scrollAcc || 0) + dy;
         while (Math.abs(tp.scrollAcc) >= 20) {
           const bit = tp.scrollAcc > 0 ? 8 : 16;   // natural scrolling
           this.client.send(`m2,0,0,${this.buttonMask | bit},1`);
+          this.client.send(`m2,0,0,${this.buttonMask},0`);
           tp.scrollAcc -= Math.sign(tp.scrollAcc) * 20;
         }
       } else {
