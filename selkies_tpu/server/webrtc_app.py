@@ -122,6 +122,9 @@ class WebRTCStreamingApp:
         GSTWebRTCApp.start_pipeline, gstwebrtc_app.py:1676)."""
         self.pc = PeerConnection(interfaces=self.interfaces)
         self.video_sender = self.pc.add_video_sender()
+        fec_pct = int(getattr(self.settings, "video_packetloss_percent", 0))
+        if fec_pct > 0:
+            self.video_sender.enable_fec(fec_pct)
         self.audio_sender = self.pc.add_audio_sender()
         self.input_channel = self.pc.create_data_channel(
             "input", ordered=True, max_retransmits=0)

@@ -224,6 +224,11 @@ SETTING_DEFINITIONS: List[Spec] = [
     EnumSpec("audio_bitrate", "320000", "Default audio bitrate.",
              allowed=("64000", "128000", "265000", "320000")),
 
+    # Forward error correction (WebRTC mode; reference
+    # legacy/gstwebrtc_app.py video_packetloss_percent -> ulpfec)
+    IntSpec("video_packetloss_percent", 0,
+            "Video ULP/RED FEC overhead percent (0 disables)."),
+
     # Display / resolution
     BoolSpec("is_manual_resolution_mode", False, "Lock resolution to manual width/height."),
     IntSpec("manual_width", 0, "Fixed width (forces manual resolution mode)."),

@@ -22,6 +22,8 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .dtls import DtlsCertificate, DtlsEndpoint
+from .fec import (RED_PT, ULPFEC_PT, UlpFecDecoder, UlpFecEncoder,
+                  red_unwrap, red_wrap)
 from .h264 import H264Depayloader, H264Payloader
 from .ice import Candidate, IceAgent
 from .jitterbuffer import JitterBuffer
@@ -63,6 +65,13 @@ class MediaSender:
         self._last_send_wall: float = 0.0
         #: recent wire packets for NACK retransmission (seq -> raw RTP)
         self._sent: Dict[int, bytes] = {}
+        self._fec: Optional[UlpFecEncoder] = None
+
+    def enable_fec(self, percentage: int) -> None:
+        """RED+ULPFEC on this (video) stream, FEC overhead ≈ percentage of
+        media packets (reference: ulpfec percentage,
+        legacy/gstwebrtc_app.py:996-1000). 0 disables."""
+        self._fec = UlpFecEncoder(percentage) if percentage > 0 else None
 
     def send_frame(self, payload: bytes, timestamp: int) -> None:
         """Packetize + protect + ship one encoded frame/AU."""
@@ -74,16 +83,42 @@ class MediaSender:
         for pkt in packets:
             # transport-wide sequencing feeds the sender-side GCC estimator
             pkt.extensions[TWCC_EXT_ID] = pack_twcc_seq(self.pc._next_twcc())
-            raw = pkt.serialize()
-            self.packet_count += 1
-            self.octet_count += len(pkt.payload)
-            self._sent[pkt.sequence_number] = raw
-            while len(self._sent) > 512:
-                # dicts are insertion-ordered: drop the oldest send, which
-                # survives sequence wraparound (a numeric sort would evict
-                # the NEWEST packets right after a wrap)
-                del self._sent[next(iter(self._sent))]
-            self.pc._send_rtp(raw)
+            if self._fec is None:
+                self._ship(pkt.sequence_number, pkt.serialize(),
+                           len(pkt.payload))
+                continue
+            # FEC protects the packet in its media form; the wire carries
+            # the RED-encapsulated twin (same header, RED PT, 1-byte block
+            # header) — matching libwebrtc's RED/ULPFEC arrangement.
+            media_raw = pkt.serialize()
+            fec_payload = self._fec.push(media_raw)
+            inner = pkt.payload
+            pkt.payload_type = RED_PT
+            pkt.payload = red_wrap(self.payload_type, inner)
+            self._ship(pkt.sequence_number, pkt.serialize(), len(inner))
+            if fec_payload is not None:
+                self._send_fec(fec_payload, timestamp)
+
+    def _send_fec(self, fec_payload: bytes, timestamp: int) -> None:
+        seq = self.sequence
+        self.sequence = (self.sequence + 1) & 0xFFFF
+        pkt = RtpPacket(
+            payload_type=RED_PT, sequence_number=seq,
+            timestamp=timestamp & 0xFFFFFFFF, ssrc=self.ssrc,
+            payload=red_wrap(ULPFEC_PT, fec_payload))
+        pkt.extensions[TWCC_EXT_ID] = pack_twcc_seq(self.pc._next_twcc())
+        self._ship(seq, pkt.serialize(), len(pkt.payload))
+
+    def _ship(self, seq: int, raw: bytes, payload_len: int) -> None:
+        self.packet_count += 1
+        self.octet_count += payload_len
+        self._sent[seq] = raw
+        while len(self._sent) > 512:
+            # dicts are insertion-ordered: drop the oldest send, which
+            # survives sequence wraparound (a numeric sort would evict
+            # the NEWEST packets right after a wrap)
+            del self._sent[next(iter(self._sent))]
+        self.pc._send_rtp(raw)
 
     def resend(self, sequence_numbers) -> int:
         """NACK retransmission from the recent-packet buffer."""
@@ -123,6 +158,7 @@ class MediaReceiver:
         self.on_frame: Optional[Callable[[bytes, int], None]] = None
         self.last_ssrc = 0
         self.packets = 0
+        self.fec = UlpFecDecoder()
 
     def feed(self, packet: RtpPacket) -> None:
         self.last_ssrc = packet.ssrc
@@ -132,9 +168,39 @@ class MediaReceiver:
                 self.on_frame(self.depayloader.feed(packet), packet.timestamp)
             return
         for pkt in self.jitter.add(packet):
+            if pkt.payload_type == ULPFEC_PT:
+                continue      # seq-space placeholder (see feed_red)
             frame = self.depayloader.feed(pkt)
             if frame is not None and self.on_frame is not None:
                 self.on_frame(frame, pkt.timestamp)
+
+    def feed_red(self, packet: RtpPacket) -> None:
+        """RED-encapsulated input: unwrap blocks, route ULPFEC payloads to
+        the recovery cache, media blocks to the normal path, and feed any
+        packets FEC can now reconstruct."""
+        for pt, data in red_unwrap(packet.payload):
+            if pt == ULPFEC_PT:
+                self.fec.add_fec(data)
+                # FEC packets share the media sequence space (RFC 5109
+                # with RED) — run an empty placeholder through the jitter
+                # buffer so its seq doesn't head-of-line block the stream
+                self.feed(RtpPacket(
+                    payload_type=ULPFEC_PT,
+                    sequence_number=packet.sequence_number,
+                    timestamp=packet.timestamp, ssrc=packet.ssrc))
+                continue
+            media = RtpPacket(
+                payload_type=pt, sequence_number=packet.sequence_number,
+                timestamp=packet.timestamp, ssrc=packet.ssrc,
+                payload=data, marker=packet.marker,
+                csrc=list(packet.csrc), extensions=dict(packet.extensions))
+            self.fec.add_media(media.serialize())
+            self.feed(media)
+        for raw in self.fec.try_recover(packet.ssrc):
+            try:
+                self.feed(RtpPacket.parse(raw))
+            except ValueError:
+                continue
 
 
 class PeerConnection:
@@ -400,6 +466,9 @@ class PeerConnection:
             seq = int.from_bytes(ext, "big")
             self._twcc_recv[seq] = int(time.monotonic() * 1e6)
             self._twcc_recv_ssrc = pkt.ssrc
+        if pkt.payload_type == RED_PT:
+            self.video_receiver().feed_red(pkt)
+            return
         recv = self.receivers.get(pkt.payload_type)
         if recv is not None:
             recv.feed(pkt)

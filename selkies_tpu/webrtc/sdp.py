@@ -234,12 +234,18 @@ class SessionDescription:
 # webrtcbin negotiate in the reference, gstwebrtc_app.py:944-984).
 
 def default_video_codecs() -> List[RtpCodec]:
-    return [RtpCodec(
-        payload_type=102, name="H264", clock_rate=90000,
-        fmtp="level-asymmetry-allowed=1;packetization-mode=1;"
-             "profile-level-id=42e01f",
-        rtcp_fb=["nack", "nack pli", "ccm fir", "goog-remb",
-                 "transport-cc"])]
+    return [
+        RtpCodec(
+            payload_type=102, name="H264", clock_rate=90000,
+            fmtp="level-asymmetry-allowed=1;packetization-mode=1;"
+                 "profile-level-id=42e01f",
+            rtcp_fb=["nack", "nack pli", "ccm fir", "goog-remb",
+                     "transport-cc"]),
+        # RED/ULPFEC (RFC 2198/5109) — negotiated so the browser's native
+        # stack accepts the FEC-protected wire format (webrtc/fec.py)
+        RtpCodec(payload_type=103, name="red", clock_rate=90000),
+        RtpCodec(payload_type=104, name="ulpfec", clock_rate=90000),
+    ]
 
 
 def default_audio_codecs() -> List[RtpCodec]:

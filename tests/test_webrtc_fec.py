@@ -1,0 +1,206 @@
+"""RED + ULP FEC (RFC 2198/5109): unit round-trips and loss recovery
+through the full PeerConnection stack.
+
+Parity target: the reference's ulpfec video protection
+(``legacy/gstwebrtc_app.py:996-1000``, video_packetloss_percent knob).
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from selkies_tpu.webrtc.fec import (RED_PT, ULPFEC_PT, UlpFecDecoder,
+                                    UlpFecEncoder, build_fec, parse_fec,
+                                    recover, red_unwrap, red_wrap)
+from selkies_tpu.webrtc.rtp import RtpPacket
+
+
+def mk_media(seq, payload, ts=1000, ssrc=0x42, marker=0, pt=102):
+    return RtpPacket(payload_type=pt, sequence_number=seq, timestamp=ts,
+                     ssrc=ssrc, payload=payload, marker=marker).serialize()
+
+
+def test_red_wrap_unwrap_roundtrip():
+    blocks = red_unwrap(red_wrap(102, b"hello"))
+    assert blocks == [(102, b"hello")]
+
+
+def test_red_unwrap_redundant_blocks():
+    # one redundant block (4-byte header) + primary
+    red = bytes([0x80 | 104, 0, 0, 3]) + bytes([102]) + b"FEC" + b"primary"
+    assert red_unwrap(red) == [(104, b"FEC"), (102, b"primary")]
+
+
+def test_red_unwrap_truncated():
+    assert red_unwrap(bytes([0x80 | 104, 0])) == []
+    assert red_unwrap(b"") == []
+
+
+def test_fec_recovers_each_single_loss():
+    pkts = [mk_media(100 + i, bytes([i]) * (20 + 7 * i), ts=5000 + i)
+            for i in range(4)]
+    fec_payload = build_fec(pkts)
+    fec = parse_fec(fec_payload)
+    assert fec is not None
+    assert fec.sn_base == 100 and fec.offsets == (0, 1, 2, 3)
+    for lost in range(4):
+        have = {100 + i: pkts[i] for i in range(4) if i != lost}
+        got = recover(fec, have, ssrc=0x42)
+        assert got is not None
+        seq, raw = got
+        assert seq == 100 + lost
+        assert raw == pkts[lost], f"loss {lost} not bit-exact"
+
+
+def test_fec_refuses_double_loss():
+    pkts = [mk_media(7 + i, b"x" * 30) for i in range(4)]
+    fec = parse_fec(build_fec(pkts))
+    have = {7: pkts[0], 8: pkts[1]}          # two missing
+    assert recover(fec, have, ssrc=1) is None
+
+
+def test_fec_sequence_wrap():
+    pkts = [mk_media((0xFFFE + i) & 0xFFFF, bytes([i]) * 25) for i in range(4)]
+    fec = parse_fec(build_fec(pkts))
+    have = {p: r for p, r in
+            zip([0xFFFE, 0xFFFF, 0, 1], pkts) if p != 0}
+    got = recover(fec, have, ssrc=0x42)
+    assert got is not None and got[0] == 0
+    assert got[1] == pkts[2]
+
+
+def test_fec_preserves_marker_and_extension():
+    pkt = RtpPacket(payload_type=102, sequence_number=55, timestamp=777,
+                    ssrc=3, payload=b"z" * 40, marker=1,
+                    extensions={2: b"\x01\x02"})
+    other = mk_media(56, b"w" * 10)
+    fec = parse_fec(build_fec([pkt.serialize(), other]))
+    got = recover(fec, {56: other}, ssrc=3)
+    assert got is not None
+    rec = RtpPacket.parse(got[1])
+    assert rec.marker == 1
+    assert rec.extensions.get(2) == b"\x01\x02"
+    assert rec.payload == b"z" * 40
+    assert rec.timestamp == 777
+
+
+def test_decoder_recovers_out_of_order_fec_first():
+    enc = UlpFecEncoder(50)                  # group of 2
+    dec = UlpFecDecoder()
+    p0, p1 = mk_media(10, b"a" * 21), mk_media(11, b"b" * 33)
+    assert enc.push(p0) is None
+    fec_payload = enc.push(p1)
+    assert fec_payload is not None
+    dec.add_fec(fec_payload)                 # FEC arrives before media
+    dec.add_media(p0)
+    recovered = dec.try_recover(ssrc=0x42)
+    assert recovered == [p1]
+    assert dec.recovered_count == 1
+    # group satisfied: FEC must be spent, not re-recovered
+    assert dec.try_recover(ssrc=0x42) == []
+
+
+def test_encoder_percentage_to_group_size():
+    assert UlpFecEncoder(100).group == 1
+    assert UlpFecEncoder(50).group == 2
+    assert UlpFecEncoder(25).group == 4
+    assert UlpFecEncoder(5).group == 16
+    assert UlpFecEncoder(1).group == 16
+
+
+def test_sender_emits_red_and_fec(monkeypatch):
+    """MediaSender with FEC on: media goes out RED-wrapped, one FEC packet
+    per group, and the receiver-side path reassembles frames."""
+    from selkies_tpu.webrtc.peerconnection import MediaReceiver, MediaSender
+
+    class FakePC:
+        def __init__(self):
+            self.sent = []
+            self._twcc = 0
+
+        def _next_twcc(self):
+            self._twcc = (self._twcc + 1) & 0xFFFF
+            return self._twcc
+
+        def _send_rtp(self, raw, record_twcc=True):
+            self.sent.append(raw)
+
+    pc = FakePC()
+    sender = MediaSender(pc, "video", ssrc=0x77, payload_type=102,
+                         clock_rate=90000)
+    sender.enable_fec(50)                    # 1 FEC per 2 media packets
+    # large enough that the payloader fragments into several packets, so
+    # the group-of-2 FEC encoder completes at least one group
+    au = b"\x00\x00\x00\x01\x67\x01\x02" + b"\x00\x00\x00\x01\x65" + b"Q" * 3000
+    sender.send_frame(au, timestamp=3000)
+    pkts = [RtpPacket.parse(r) for r in pc.sent]
+    assert all(p.payload_type == RED_PT for p in pkts)
+    inner = [red_unwrap(p.payload)[0][0] for p in pkts]
+    assert ULPFEC_PT in inner and 102 in inner
+
+    # drop ONE media packet; the receiver must still produce the frame
+    media = [p for p in pkts if red_unwrap(p.payload)[0][0] == 102]
+    keep = [p for p in pkts if p is not media[0]]
+    recv = MediaReceiver("video")
+    frames = []
+    recv.on_frame = lambda f, ts: frames.append(f)
+    for p in keep:
+        recv.feed_red(p)
+    assert frames and frames[0].endswith(b"Q" * 3000)
+    assert recv.fec.recovered_count == 1
+
+
+def test_fec_recovery_end_to_end_no_nack():
+    """Full stack loopback with deterministic media loss and NACK disabled:
+    only FEC can heal the stream."""
+    from selkies_tpu.webrtc.peerconnection import PeerConnection
+
+    async def run():
+        a = PeerConnection(interfaces=["127.0.0.1"])
+        b = PeerConnection(interfaces=["127.0.0.1"])
+        b._send_nacks = lambda: None         # force FEC-only recovery
+        video = a.add_video_sender(ssrc=0xAA)
+        video.enable_fec(50)
+        got = []
+        b.video_receiver().on_frame = lambda f, ts: got.append((f, ts))
+
+        offer = await a.create_offer()
+        await b.set_remote_description(offer, "offer")
+        answer = await b.create_answer()
+        await a.set_remote_description(answer, "answer")
+        await asyncio.gather(a.wait_connected(15), b.wait_connected(15))
+
+        # deterministically drop every 3rd MEDIA packet at the sender.
+        # (A lost FEC packet is recovered by NACK/RTX in production — FEC's
+        # own promise, tested here with NACK disabled, is healing media
+        # loss with zero feedback round trips.)
+        real_send = a._send_rtp
+        media_count = [0]
+
+        def lossy_send(raw, record_twcc=True):
+            pkt = RtpPacket.parse(raw)
+            inner_pt = pkt.payload[0] & 0x7F if pkt.payload else -1
+            if pkt.payload_type == RED_PT and inner_pt != ULPFEC_PT:
+                media_count[0] += 1
+                if media_count[0] % 3 == 0:
+                    return                   # media lost on the "wire"
+            real_send(raw, record_twcc)
+
+        a._send_rtp = lossy_send
+        sps = bytes([0x67, 1, 2, 3])
+        for i in range(12):
+            au = (b"\x00\x00\x00\x01" + sps + b"\x00\x00\x00\x01" +
+                  bytes([0x65]) + bytes([i]) * 700)
+            video.send_frame(au, timestamp=i * 3000)
+            await asyncio.sleep(0.02)
+        for _ in range(150):
+            if len(got) >= 12:
+                break
+            await asyncio.sleep(0.05)
+        assert len(got) == 12, f"only {len(got)} frames under 33% media loss"
+        assert b.video_receiver().fec.recovered_count >= 3
+        await a.close()
+        await b.close()
+
+    asyncio.run(run())
