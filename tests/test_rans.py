@@ -1,0 +1,109 @@
+"""rANS coder tests (config-3 gate instrument, encoder/rans.py)."""
+
+import numpy as np
+import pytest
+
+from selkies_tpu.encoder import rans
+
+
+def sparse_planes(seed=0, ny=64, nc=16):
+    rng = np.random.default_rng(seed)
+
+    def mk(n):
+        p = np.zeros((n, 64), np.int16)
+        for i in range(n):
+            k = int(rng.integers(1, 20))
+            idx = np.sort(rng.choice(64, size=k, replace=False))
+            p[i, idx] = rng.integers(-40, 41, size=k)
+            p[i, 0] = rng.integers(-200, 201)
+        return p
+
+    return mk(ny), mk(nc), mk(nc)
+
+
+def test_rans_stream_roundtrip():
+    rng = np.random.default_rng(1)
+    syms = rng.integers(0, 20, 5000).astype(np.int32)
+    freqs = rans.build_model(syms, alphabet=32)
+    blob = rans.rans_encode(syms, freqs)
+    out = rans.rans_decode(blob, freqs, len(syms))
+    assert np.array_equal(out, syms)
+
+
+def test_rans_skewed_model():
+    # heavily skewed distribution — the case rANS is for
+    syms = np.asarray([0] * 9000 + [1] * 100 + [7] * 5, np.int32)
+    np.random.default_rng(2).shuffle(syms)
+    freqs = rans.build_model(syms, alphabet=8)
+    blob = rans.rans_encode(syms, freqs)
+    assert np.array_equal(rans.rans_decode(blob, freqs, len(syms)), syms)
+    # ~0.12 bits/symbol entropy → far under 1 byte/symbol
+    assert len(blob) < len(syms) // 4
+
+
+def test_model_header_roundtrip():
+    syms = np.asarray([3, 3, 3, 7, 250], np.int32)
+    freqs = rans.build_model(syms)
+    hdr = rans.model_header(freqs)
+    freqs2, consumed = rans.parse_model_header(hdr)
+    assert consumed == len(hdr)
+    assert np.array_equal(freqs, freqs2)
+    assert int(freqs2.sum()) == rans.PROB_SCALE
+
+
+def test_value_bits_roundtrip():
+    rng = np.random.default_rng(3)
+    vlens = rng.integers(1, 11, 200).astype(np.int32)
+    vbits = np.asarray([int(rng.integers(0, 1 << l)) for l in vlens],
+                       np.int64)
+    packed = rans.pack_value_bits(vbits, vlens)
+    out = rans.unpack_value_bits(packed, vlens)
+    assert np.array_equal(out, vbits)
+
+
+def test_planes_roundtrip():
+    y, cb, cr = sparse_planes()
+    blob = rans.encode_planes(y, cb, cr, blocks_per_stripe_y=16)
+    y2, c2 = rans.decode_planes(blob, len(y), len(cb) + len(cr), 16)
+    assert np.array_equal(y2, y)
+    assert np.array_equal(c2, np.concatenate([cb, cr]))
+
+
+def test_planes_roundtrip_all_zero():
+    z = np.zeros((8, 64), np.int16)
+    zc = np.zeros((4, 64), np.int16)
+    blob = rans.encode_planes(z, zc, zc, 8)
+    y2, c2 = rans.decode_planes(blob, 8, 8, 8)
+    assert not y2.any() and not c2.any()
+    assert len(blob) < 100
+
+
+def test_planes_roundtrip_max_magnitude():
+    # size-10 AC values and large DC swings
+    y = np.zeros((4, 64), np.int16)
+    y[:, 0] = [1000, -1000, 900, -900]
+    y[:, 1] = [1023, -1023, 512, -512]
+    y[:, 63] = 5                       # block ends on coeff 63 — no EOB
+    zc = np.zeros((2, 64), np.int16)
+    blob = rans.encode_planes(y, zc, zc, 4)
+    y2, _ = rans.decode_planes(blob, 4, 4, 4)
+    assert np.array_equal(y2, y)
+
+
+def test_zrl_runs():
+    # 16+ zero runs exercise ZRL symbols
+    y = np.zeros((2, 64), np.int16)
+    y[0, 40] = 3                       # run of 39 zeros → 2 ZRLs + (7,size)
+    y[1, 17] = -2
+    zc = np.zeros((2, 64), np.int16)
+    blob = rans.encode_planes(y, zc, zc, 2)
+    y2, _ = rans.decode_planes(blob, 2, 4, 2)
+    assert np.array_equal(y2, y)
+
+
+def test_decision_memo_exists():
+    import os
+    memo = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "config3_decision.md")
+    text = open(memo).read()
+    assert "Decision" in text and "rANS" in text

@@ -1,0 +1,103 @@
+"""Config-3 gate measurement: Huffman scan vs adaptive-rANS bitstream on
+identical quantized DCT planes (see docs/config3_decision.md).
+
+Usage: JAX_PLATFORMS=cpu python tools/config3_measure.py [WIDTH HEIGHT]
+Prints per-content-class byte counts for
+  - the shipping JPEG Huffman scan (native coder, actual wire bytes), and
+  - the rANS candidate profile (selkies_tpu/encoder/rans.py), which pairs
+    per-frame adaptive models with the same symbol decomposition.
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from selkies_tpu.encoder import rans  # noqa: E402
+from selkies_tpu.encoder.jpeg import _encode_body, _entropy_encode_420  # noqa: E402
+from selkies_tpu.ops.quant import quality_scaled_tables  # noqa: E402
+
+
+def smooth(h, w):
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    r = 128 + 100 * np.sin(xx / 97.0) * np.cos(yy / 53.0)
+    g = 128 + 100 * np.cos(xx / 71.0)
+    b = 128 + 100 * np.sin(yy / 89.0)
+    return np.clip(np.stack([r, g, b], -1), 0, 255).astype(np.uint8)
+
+
+def desktop(h, w, seed=3):
+    """Window rectangles + text-like speckle — the actual workload shape."""
+    rng = np.random.default_rng(seed)
+    f = np.full((h, w, 3), 235, np.uint8)
+    for _ in range(12):
+        y0, x0 = rng.integers(0, h - 40), rng.integers(0, w - 80)
+        hh, ww = rng.integers(30, h - y0), rng.integers(60, w - x0)
+        f[y0:y0 + 2, x0:x0 + ww] = rng.integers(40, 100, 3)
+        f[y0:y0 + hh, x0:x0 + 2] = f[y0:y0 + 2, x0:x0 + 2][0, 0]
+        f[y0 + 2:y0 + hh, x0 + 2:x0 + ww] = rng.integers(180, 255, 3)
+    # text rows: high-contrast speckle lines
+    for row in range(20, h - 10, 28):
+        mask = rng.random((8, w - 40)) < 0.25
+        band = f[row:row + 8, 20:w - 20]
+        band[mask] = 20
+    return f
+
+
+def noisy(h, w, seed=9):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+
+
+def measure(frame, quality=40, stripe_h=64):
+    import jax.numpy as jnp
+    h, w = frame.shape[:2]
+    ly, lc = quality_scaled_tables(quality)
+    qy = jnp.stack([jnp.asarray(ly, jnp.float32)] * 2)
+    qc = jnp.stack([jnp.asarray(lc, jnp.float32)] * 2)
+    qsel = jnp.zeros((h // stripe_h,), jnp.int32)
+    yq, cbq, crq, _, _ = _encode_body(
+        jnp.asarray(frame), jnp.zeros_like(jnp.asarray(frame)),
+        qy, qc, qsel, stripe_h=stripe_h)
+    yq, cbq, crq = (np.asarray(x) for x in (yq, cbq, crq))
+
+    # shipping baseline: per-stripe Huffman scans (wire bytes incl. stuffing)
+    by, bx = yq.shape[0] // 1, yq.shape[1]
+    ys = h // stripe_h
+    huff = 0
+    rows_per_stripe = stripe_h // 8
+    crows = stripe_h // 16
+    for s in range(ys):
+        yb = yq[s * rows_per_stripe:(s + 1) * rows_per_stripe]
+        cb = cbq[s * crows:(s + 1) * crows]
+        cr = crq[s * crows:(s + 1) * crows]
+        huff += len(_entropy_encode_420(yb, cb, cr))
+
+    blocks_per_stripe_y = rows_per_stripe * bx
+    blob = rans.encode_planes(yq, cbq, crq, blocks_per_stripe_y)
+    return huff, len(blob), yq, cbq, crq, blocks_per_stripe_y
+
+
+def main():
+    w, h = (int(sys.argv[1]), int(sys.argv[2])) if len(sys.argv) > 2 \
+        else (1280, 704)
+    print(f"frame {w}x{h}, q40, stripe 64")
+    print(f"{'content':<10} {'huffman':>10} {'rans':>10} {'delta':>8}")
+    for name, frame in (("smooth", smooth(h, w)),
+                        ("desktop", desktop(h, w)),
+                        ("noise", noisy(h, w))):
+        huff, rb, yq, cbq, crq, bps = measure(frame)
+        delta = 100.0 * (1 - rb / huff)
+        print(f"{name:<10} {huff:>10} {rb:>10} {delta:>7.1f}%")
+        # verify the rANS stream actually decodes back to the planes
+        y2, c2 = rans.decode_planes(
+            rans.encode_planes(yq, cbq, crq, bps),
+            yq.shape[0] * yq.shape[1], 2 * cbq.shape[0] * cbq.shape[1], bps)
+        ok = np.array_equal(y2, yq.reshape(-1, 64)) and np.array_equal(
+            c2, np.concatenate([cbq.reshape(-1, 64), crq.reshape(-1, 64)]))
+        print(f"{'':<10} rans round-trip: {'OK' if ok else 'FAIL'}")
+
+
+if __name__ == "__main__":
+    main()
