@@ -127,11 +127,11 @@ def test_touch_gamepad_contract():
 
 
 # ------------------------------------------------------------ syntax lint
-# No JS runtime exists in this image (no node/bun/quickjs, no browser), so
-# the client cannot be executed here; tools/jscheck.py is the strongest
-# automatic gate available — a string/template/regex-aware tokenizer with
-# bracket balancing that fails CI on the typo classes that previously
-# could ship silently.
+# No JS runtime ships in this image (no node/bun/quickjs, no browser), so
+# the client is EXECUTED by tools/minijs.py instead — see
+# tests/test_web_client_exec.py for the behavioral coverage (demux, ACK
+# wraparound, decoder pools, input mapping, dashboard). tools/jscheck.py
+# remains as a fast whole-file lint gate alongside it.
 
 import pathlib
 import sys

@@ -1,0 +1,723 @@
+"""Browser environment stubs for executing web/*.js under tools/minijs.
+
+Python implementations of the DOM/WebCodecs/WebAudio surface the client
+uses — just behavioral enough that the real client logic (demux, ACK,
+decode ordering, input mapping, dashboard rendering) runs and can be
+asserted against. Every stub records what the client did to it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import urllib.parse
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tools.minijs import (  # noqa: E402
+    UNDEF, Interp, JSArray, JSArrayBuffer, JSObject, JSPromise,
+    JSTypedArray, NativeFunction, normalize_host, to_num, to_str)
+
+WEB = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "web")
+
+
+def _nf(fn, name=""):
+    return NativeFunction(lambda this, args, interp: fn(*args), name)
+
+
+# ------------------------------------------------------------------- DOM
+
+
+class ClassList:
+    def __init__(self):
+        self._set = set()
+
+    def add(self, *names):
+        self._set.update(to_str(n) for n in names)
+
+    def remove(self, *names):
+        for n in names:
+            self._set.discard(to_str(n))
+
+    def toggle(self, name, force=UNDEF):
+        name = to_str(name)
+        if force is not UNDEF:
+            (self._set.add if force else self._set.discard)(name)
+            return bool(force)
+        if name in self._set:
+            self._set.discard(name)
+            return False
+        self._set.add(name)
+        return True
+
+    def contains(self, name):
+        return to_str(name) in self._set
+
+
+class Style:
+    def __init__(self):
+        self.cssText = ""
+        self.display = ""
+        self.width = ""
+        self.height = ""
+        self.left = ""
+        self.top = ""
+        self.background = ""
+        self.transform = ""
+
+
+class Element:
+    def __init__(self, env: "BrowserEnv", tag: str):
+        self._env = env
+        self.tagName = tag.upper()
+        self.children = JSArray([])
+        self.style = Style()
+        self.classList = ClassList()
+        self.attrs: Dict[str, Any] = {}
+        self.listeners: Dict[str, list] = {}
+        self.textContent = ""
+        self.value = ""
+        self.checked = False
+        self.disabled = False
+        self.className = ""
+        self.id = ""
+        self.type = ""
+        self.min = ""
+        self.max = ""
+        self.step = ""
+        self.title = ""
+        self.href = ""
+        self.download = ""
+        self.placeholder = ""
+        self.parentNode = None
+        self.width = 0.0
+        self.height = 0.0
+        self.files = JSArray([])
+        self.onclick = None
+        self.oninput = None
+        self.onchange = None
+        self.innerHTML = ""
+        self.src = ""
+        self.rows = ""
+        self.multiple = ""
+
+    @property
+    def childNodes(self):
+        return self.children
+
+    # -- tree
+    def appendChild(self, child):
+        self.children.elems.append(child)
+        if isinstance(child, Element):
+            child.parentNode = self
+        return child
+
+    def append(self, *children):
+        for c in children:
+            if isinstance(c, Element):
+                self.appendChild(c)
+            else:
+                self.textContent += to_str(c)
+
+    def remove(self):
+        if self.parentNode is not None:
+            try:
+                self.parentNode.children.elems.remove(self)
+            except ValueError:
+                pass
+            self.parentNode = None
+
+    def contains(self, other):
+        for c in self.children.elems:
+            if c is other or (isinstance(c, Element) and c.contains(other)):
+                return True
+        return False
+
+    # -- attributes / events
+    def setAttribute(self, name, value):
+        name = to_str(name)
+        self.attrs[name] = value
+        if name in ("id", "type", "min", "max", "step", "title",
+                    "placeholder", "value", "download"):
+            setattr(self, name, value)
+        if name == "disabled":
+            self.disabled = True
+
+    def getAttribute(self, name):
+        return self.attrs.get(to_str(name))
+
+    def addEventListener(self, type_, fn, opts=UNDEF):
+        self.listeners.setdefault(to_str(type_), []).append(fn)
+
+    def removeEventListener(self, type_, fn, opts=UNDEF):
+        lst = self.listeners.get(to_str(type_), [])
+        if fn in lst:
+            lst.remove(fn)
+
+    def dispatchEvent(self, ev):
+        self._env.fire(self, getattr(ev, "type", "event"), ev)
+
+    # -- misc behavior
+    def focus(self, opts=UNDEF):
+        self._env.focused = self
+
+    def click(self):
+        self._env.fire(self, "click")
+
+    def getContext(self, kind):
+        if self._ctx is None:
+            self._ctx = Context2D()
+        return self._ctx
+
+    _ctx = None
+
+    def getBoundingClientRect(self):
+        return JSObject({"left": 0.0, "top": 0.0,
+                         "width": float(self.width or 100),
+                         "height": float(self.height or 100)})
+
+    def requestPointerLock(self):
+        self._env.pointer_lock_target = self
+
+    def requestFullscreen(self):
+        return self._env.resolved(UNDEF)
+
+    def arc(self, *a):
+        pass
+
+    # tree search used by tests
+    def find_all(self, pred, out=None):
+        out = out if out is not None else []
+        for c in self.children.elems:
+            if isinstance(c, Element):
+                if pred(c):
+                    out.append(c)
+                c.find_all(pred, out)
+        return out
+
+
+class Context2D:
+    def __init__(self):
+        self.draw_calls: List[tuple] = []
+        self.fillStyle = ""
+        self.strokeStyle = ""
+        self.font = ""
+        self.lineWidth = 1.0
+
+    def drawImage(self, img, x, y, *rest):
+        self.draw_calls.append((img, to_num(x), to_num(y)))
+
+    def clearRect(self, *a):
+        self.draw_calls.append(("clear",))
+
+    def fillRect(self, *a):
+        pass
+
+    def fillText(self, *a):
+        pass
+
+    def beginPath(self, *a):
+        pass
+
+    def arc(self, *a):
+        pass
+
+    def stroke(self, *a):
+        pass
+
+    def fill(self, *a):
+        pass
+
+
+class Document:
+    def __init__(self, env):
+        self._env = env
+        self.body = Element(env, "body")
+        self.documentElement = Element(env, "html")
+        self.listeners: Dict[str, list] = {}
+        self.pointerLockElement = None
+
+    def createElement(self, tag):
+        return Element(self._env, to_str(tag))
+
+    def addEventListener(self, type_, fn, opts=UNDEF):
+        self.listeners.setdefault(to_str(type_), []).append(fn)
+
+    def removeEventListener(self, type_, fn, opts=UNDEF):
+        lst = self.listeners.get(to_str(type_), [])
+        if fn in lst:
+            lst.remove(fn)
+
+    def exitPointerLock(self):
+        self.pointerLockElement = None
+
+
+class FakeWindow:
+    def __init__(self, env):
+        self._env = env
+        self.listeners: Dict[str, list] = {}
+        self.devicePixelRatio = 1.0
+        self.innerWidth = 1920.0
+        self.innerHeight = 1080.0
+
+    def addEventListener(self, type_, fn, opts=UNDEF):
+        self.listeners.setdefault(to_str(type_), []).append(fn)
+
+    def removeEventListener(self, type_, fn, opts=UNDEF):
+        lst = self.listeners.get(to_str(type_), [])
+        if fn in lst:
+            lst.remove(fn)
+
+    def dispatchEvent(self, ev):
+        type_ = to_str(self._env.interp.get_prop(ev, "type"))
+        for fn in list(self.listeners.get(type_, [])):
+            self._env.call(fn, [ev])
+
+
+# ------------------------------------------------------------ WebSocket
+
+
+class FakeWebSocket:
+    CONNECTING, OPEN, CLOSING, CLOSED = 0.0, 1.0, 2.0, 3.0
+
+    def __init__(self, env, url):
+        self._env = env
+        self.url = to_str(url)
+        self.binaryType = ""
+        self.readyState = FakeWebSocket.CONNECTING
+        self.sent: List[Any] = []          # str or bytes
+        self.bufferedAmount = 0.0
+        self.onopen = None
+        self.onmessage = None
+        self.onclose = None
+        self.onerror = None
+        env.sockets.append(self)
+
+    def send(self, data):
+        if isinstance(data, str):
+            self.sent.append(data)
+        elif isinstance(data, JSArrayBuffer):
+            self.sent.append(bytes(data.data))
+        elif isinstance(data, JSTypedArray):
+            off = data.offset
+            self.sent.append(bytes(
+                data.buffer.data[off:off + data.length * data.itemsize]))
+        else:
+            self.sent.append(data)
+
+    def close(self):
+        self.readyState = FakeWebSocket.CLOSED
+        if self.onclose is not None:
+            self._env.call(self.onclose, [JSObject({})])
+
+    # test helpers -----------------------------------------------------
+    def server_open(self):
+        self.readyState = FakeWebSocket.OPEN
+        if self.onopen is not None:
+            self._env.call(self.onopen, [JSObject({})])
+
+    def server_text(self, text: str):
+        ev = JSObject({"data": text})
+        if self.onmessage is not None:
+            self._env.call(self.onmessage, [ev])
+
+    def server_binary(self, data: bytes):
+        ev = JSObject({"data": JSArrayBuffer(bytearray(data))})
+        if self.onmessage is not None:
+            self._env.call(self.onmessage, [ev])
+
+    def texts(self) -> List[str]:
+        return [s for s in self.sent if isinstance(s, str)]
+
+
+# ------------------------------------------------------------ WebCodecs
+
+
+class FakeBitmap:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.width = 0.0
+        self.height = 0.0
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class FakeChunk:
+    def __init__(self, env, init: JSObject):
+        self.type = to_str(init.props.get("type", ""))
+        self.timestamp = to_num(init.props.get("timestamp", 0.0))
+        data = init.props.get("data")
+        if isinstance(data, JSTypedArray):
+            off = data.offset
+            self.data = bytes(
+                data.buffer.data[off:off + data.length * data.itemsize])
+        elif isinstance(data, JSArrayBuffer):
+            self.data = bytes(data.data)
+        else:
+            self.data = b""
+
+
+class FakeVideoDecoder:
+    def __init__(self, env, init: JSObject):
+        self._env = env
+        self.output_cb = init.props.get("output")
+        self.error_cb = init.props.get("error")
+        self.state = "unconfigured"
+        self.config = None
+        self.decodeQueueSize = 0.0
+        self.chunks: List[FakeChunk] = []
+        self.fail_next = False
+        env.video_decoders.append(self)
+
+    def configure(self, cfg):
+        self.state = "configured"
+        self.config = cfg
+
+    def decode(self, chunk):
+        if self.state == "closed":
+            raise RuntimeError("decoder closed")
+        if self.fail_next:
+            self.fail_next = False
+            raise RuntimeError("decode error (injected)")
+        self.chunks.append(chunk)
+        frame = JSObject({
+            "close": NativeFunction(lambda t, a, i: UNDEF, "close"),
+            "displayWidth": 0.0,
+            "codedWidth": 0.0,
+            "_chunk": chunk,
+        })
+        if self.output_cb is not None:
+            self._env.call(self.output_cb, [frame])
+
+    def close(self):
+        self.state = "closed"
+
+
+class FakeAudioData:
+    def __init__(self, frames: int, channels: int):
+        self.numberOfFrames = float(frames)
+        self.numberOfChannels = float(channels)
+        self.closed = False
+
+    def copyTo(self, arr, opts):
+        plane = to_num(opts.props.get("planeIndex", 0.0)) \
+            if isinstance(opts, JSObject) else 0.0
+        if isinstance(arr, JSTypedArray):
+            for i in range(arr.length):
+                arr.set_index(i, 0.25 + plane * 0.5)
+
+    def close(self):
+        self.closed = True
+
+
+class FakeAudioDecoder:
+    def __init__(self, env, init: JSObject):
+        self._env = env
+        self.output_cb = init.props.get("output")
+        self.state = "unconfigured"
+        self.chunks: List[FakeChunk] = []
+        env.audio_decoders.append(self)
+
+    def configure(self, cfg):
+        self.state = "configured"
+
+    def decode(self, chunk):
+        self.chunks.append(chunk)
+        if self.output_cb is not None:
+            self._env.call(self.output_cb, [FakeAudioData(960, 2)])
+
+    def close(self):
+        self.state = "closed"
+
+
+# ------------------------------------------------------------ WebAudio
+
+
+class FakePort:
+    def __init__(self):
+        self.messages: List[Any] = []
+        self.onmessage = None
+
+    def postMessage(self, msg, transfer=UNDEF):
+        self.messages.append(msg)
+
+
+class FakeWorkletNode:
+    def __init__(self, env, ctx, name, opts=UNDEF):
+        self.name = to_str(name)
+        self.port = FakePort()
+        self.connected_to = None
+        env.worklet_nodes.append(self)
+
+    def connect(self, dest):
+        self.connected_to = dest
+
+
+class FakeAudioContext:
+    def __init__(self, env, opts=UNDEF):
+        self._env = env
+        self.sampleRate = 48000.0
+        self.currentTime = 0.0
+        self.destination = JSObject({"kind": "destination"})
+        self.audioWorklet = JSObject({
+            "addModule": NativeFunction(
+                lambda t, a, i: env.resolved(UNDEF), "addModule"),
+        })
+        env.audio_contexts.append(self)
+
+    def createBuffer(self, channels, frames, rate):
+        return JSObject({
+            "duration": to_num(frames) / to_num(rate),
+            "copyToChannel": NativeFunction(
+                lambda t, a, i: UNDEF, "copyToChannel"),
+        })
+
+    def createBufferSource(self):
+        src = JSObject({
+            "buffer": None,
+            "connect": NativeFunction(lambda t, a, i: UNDEF, "connect"),
+            "start": NativeFunction(lambda t, a, i: UNDEF, "start"),
+        })
+        return src
+
+    def createMediaStreamSource(self, stream):
+        return JSObject({"connect": NativeFunction(
+            lambda t, a, i: UNDEF, "connect")})
+
+    def createScriptProcessor(self, size, ins, outs):
+        proc = Element(self._env, "scriptprocessor")
+        return proc
+
+
+# --------------------------------------------------------------- Blob
+
+
+class FakeBlob:
+    def __init__(self, env, parts=UNDEF, opts=UNDEF):
+        self._env = env
+        buf = bytearray()
+        if isinstance(parts, JSArray):
+            for p in parts.elems:
+                if isinstance(p, JSTypedArray):
+                    off = p.offset
+                    buf += p.buffer.data[off:off + p.length * p.itemsize]
+                elif isinstance(p, JSArrayBuffer):
+                    buf += p.data
+                elif isinstance(p, str):
+                    buf += p.encode()
+        self.data = bytes(buf)
+        self.size = float(len(self.data))
+        self.type = ""
+        if isinstance(opts, JSObject):
+            self.type = to_str(opts.props.get("type", ""))
+
+    def arrayBuffer(self):
+        return self._env.resolved(JSArrayBuffer(bytearray(self.data)))
+
+    def slice(self, a, b):
+        return FakeBlobSlice(self._env,
+                             self.data[int(to_num(a)):int(to_num(b))])
+
+
+class FakeBlobSlice:
+    def __init__(self, env, data):
+        self._env = env
+        self.data = data
+
+    def arrayBuffer(self):
+        return self._env.resolved(JSArrayBuffer(bytearray(self.data)))
+
+
+# ---------------------------------------------------------------- env
+
+
+class BrowserEnv:
+    """One interpreter + browser globals + loaded client files."""
+
+    def __init__(self, files=("selkies-client.js",)):
+        self.interp = Interp()
+        self.sockets: List[FakeWebSocket] = []
+        self.video_decoders: List[FakeVideoDecoder] = []
+        self.audio_decoders: List[FakeAudioDecoder] = []
+        self.audio_contexts: List[FakeAudioContext] = []
+        self.worklet_nodes: List[FakeWorkletNode] = []
+        self.bitmaps: List[FakeBitmap] = []
+        self.focused: Optional[Element] = None
+        self.pointer_lock_target: Optional[Element] = None
+        self.exports: Dict[str, Any] = {}
+
+        g = self.interp.globals
+        self.document = Document(self)
+        self.window = FakeWindow(self)
+        g.declare("document", self.document)
+        g.declare("window", self.window)
+        g.declare("location", JSObject({
+            "protocol": "http:", "host": "testhost:8080",
+            "href": "http://testhost:8080/"}))
+        g.declare("Event", NativeFunction(
+            lambda t, a, i: JSObject({"type": to_str(a[0])}), "Event"))
+        g.declare("screen", JSObject({"width": 1920.0, "height": 1080.0}))
+        g.declare("performance", JSObject({
+            "now": NativeFunction(
+                lambda t, a, i: self.interp.now_ms, "now")}))
+        self.local_storage: Dict[str, str] = {}
+        g.declare("localStorage", JSObject({
+            "getItem": NativeFunction(
+                lambda t, a, i: self.local_storage.get(to_str(a[0]), None),
+                "getItem"),
+            "setItem": NativeFunction(
+                lambda t, a, i: (self.local_storage.__setitem__(
+                    to_str(a[0]), to_str(a[1])), UNDEF)[1], "setItem"),
+            "removeItem": NativeFunction(
+                lambda t, a, i: (self.local_storage.pop(
+                    to_str(a[0]), None), UNDEF)[1], "removeItem"),
+        }))
+        self.gamepads = JSArray([])
+        self.clipboard_writes: List[str] = []
+        g.declare("navigator", JSObject({
+            "getGamepads": NativeFunction(
+                lambda t, a, i: self.gamepads, "getGamepads"),
+            "clipboard": JSObject({
+                "writeText": NativeFunction(
+                    lambda t, a, i: (self.clipboard_writes.append(
+                        to_str(a[0])), self.resolved(UNDEF))[1],
+                    "writeText"),
+            }),
+            "mediaDevices": JSObject({
+                "getUserMedia": NativeFunction(
+                    lambda t, a, i: self.resolved(JSObject({})),
+                    "getUserMedia"),
+            }),
+        }))
+        ws_ctor = NativeFunction(
+            lambda t, a, i: FakeWebSocket(self, a[0]), "WebSocket")
+        ws_ctor.OPEN = FakeWebSocket.OPEN
+        ws_ctor.CONNECTING = FakeWebSocket.CONNECTING
+        ws_ctor.CLOSED = FakeWebSocket.CLOSED
+        g.declare("WebSocket", ws_ctor)
+        g.declare("VideoDecoder", NativeFunction(
+            lambda t, a, i: FakeVideoDecoder(self, a[0]), "VideoDecoder"))
+        g.declare("AudioDecoder", NativeFunction(
+            lambda t, a, i: FakeAudioDecoder(self, a[0]), "AudioDecoder"))
+        g.declare("EncodedVideoChunk", NativeFunction(
+            lambda t, a, i: FakeChunk(self, a[0]), "EncodedVideoChunk"))
+        g.declare("EncodedAudioChunk", NativeFunction(
+            lambda t, a, i: FakeChunk(self, a[0]), "EncodedAudioChunk"))
+        g.declare("AudioContext", NativeFunction(
+            lambda t, a, i: FakeAudioContext(self, *a), "AudioContext"))
+        g.declare("AudioWorkletNode", NativeFunction(
+            lambda t, a, i: FakeWorkletNode(self, *a), "AudioWorkletNode"))
+        g.declare("Blob", NativeFunction(
+            lambda t, a, i: FakeBlob(self, *a), "Blob"))
+        g.declare("createImageBitmap", NativeFunction(
+            lambda t, a, i: self._create_bitmap(a[0]), "createImageBitmap"))
+        url_ns = JSObject({
+            "createObjectURL": NativeFunction(
+                lambda t, a, i: "blob:fake", "createObjectURL"),
+            "revokeObjectURL": NativeFunction(
+                lambda t, a, i: UNDEF, "revokeObjectURL"),
+        })
+        g.declare("URL", url_ns)
+        g.declare("Audio", NativeFunction(
+            lambda t, a, i: Element(self, "audio"), "Audio"))
+        g.declare("requestAnimationFrame", NativeFunction(
+            lambda t, a, i: 1.0, "requestAnimationFrame"))
+
+        # URI coders (clipboard path uses the classic escape/unescape trick)
+        g.declare("encodeURIComponent", _nf(
+            lambda s: urllib.parse.quote(
+                to_str(s), safe="!'()*-._~"), "encodeURIComponent"))
+        g.declare("decodeURIComponent", _nf(
+            lambda s: urllib.parse.unquote(to_str(s)),
+            "decodeURIComponent"))
+        g.declare("escape", _nf(
+            lambda s: "".join(
+                c if ((c.isascii() and c.isalnum()) or c in "*@-_+./")
+                else f"%{ord(c):02X}" for c in to_str(s)), "escape"))
+
+        def _unescape(s):
+            s = to_str(s)
+            out = []
+            i = 0
+            while i < len(s):
+                if s[i] == "%" and i + 2 < len(s) + 1:
+                    try:
+                        out.append(chr(int(s[i + 1:i + 3], 16)))
+                        i += 3
+                        continue
+                    except ValueError:
+                        pass
+                out.append(s[i])
+                i += 1
+            return "".join(out)
+
+        g.declare("unescape", _nf(_unescape, "unescape"))
+
+        for f in files:
+            self.load(f)
+
+    # ---------------------------------------------------------- helpers
+
+    def load(self, filename: str):
+        """Run one client file with a fresh CommonJS-ish module object."""
+        module = JSObject({"exports": JSObject({})})
+        self.interp.globals.declare("module", module)
+        src = open(os.path.join(WEB, filename)).read()
+        self.interp.run(src)
+        exports = module.props["exports"]
+        if isinstance(exports, JSObject):
+            self.exports.update(exports.props)
+        self.interp.globals.vars.pop("module", None)
+        return exports
+
+    def call(self, fn, args=(), this=UNDEF):
+        out = self.interp.call(fn, list(args), this=this)
+        self.interp.run_microtasks()
+        return out
+
+    def construct(self, ctor, args=()):
+        return self.interp.construct(ctor, list(args))
+
+    def resolved(self, value) -> JSPromise:
+        p = JSPromise(self.interp)
+        p.resolve(value)
+        return p
+
+    def _create_bitmap(self, blob) -> JSPromise:
+        bmp = FakeBitmap(getattr(blob, "data", b""))
+        self.bitmaps.append(bmp)
+        return self.resolved(bmp)
+
+    def get(self, obj, key):
+        return self.interp.get_prop(obj, key)
+
+    def fire(self, target, type_: str, ev=None):
+        """Dispatch an event to element/document/window listeners and
+        onXXX handler attributes."""
+        if ev is None:
+            ev = self.make_event(type_, target=target)
+        handler = getattr(target, "on" + type_, None)
+        if handler not in (None, UNDEF):
+            self.call(handler, [ev])
+        for fn in list(getattr(target, "listeners", {}).get(type_, [])):
+            self.call(fn, [ev])
+        return ev
+
+    def make_event(self, type_: str, target=None, **props):
+        base = {
+            "type": type_,
+            "target": target if target is not None else UNDEF,
+            "preventDefault": NativeFunction(
+                lambda t, a, i: UNDEF, "preventDefault"),
+            "stopPropagation": NativeFunction(
+                lambda t, a, i: UNDEF, "stopPropagation"),
+        }
+        for k, v in props.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                v = float(v)
+            base[k] = v
+        return JSObject(base)
