@@ -52,6 +52,8 @@ def env(client_env):
     client_env.audio_decoders.clear()
     client_env.bitmaps.clear()
     client_env.interp.timer_map.clear()
+    client_env.document.listeners.clear()
+    client_env.wake_locks.clear()
     return client_env
 
 
@@ -583,3 +585,18 @@ def test_touch_gamepad_patches_getgamepads(dash_env):
     pads2 = denv.call(denv.interp.globals.lookup("navigator").props[
         "getGamepads"], [])
     assert pads2 is denv.gamepads              # native restored
+
+
+def test_wake_lock_lifecycle(env):
+    client, ws, canvas = make_client(env)
+    env.interp.run_microtasks()
+    assert env.wake_locks, "wake lock not requested on connect"
+    lock = env.wake_locks[-1]
+    # tab hidden → UA releases; on return to foreground, re-acquire
+    env.document.visibilityState = "visible"
+    n0 = len(env.wake_locks)
+    for fn in env.document.listeners.get("visibilitychange", []):
+        env.call(fn, [env.make_event("visibilitychange")])
+    assert len(env.wake_locks) == n0 + 1
+    env.call(env.get(client, "disconnect"), [])
+    assert env.wake_locks[-1].props["released"] is True

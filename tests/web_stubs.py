@@ -238,6 +238,8 @@ class Document:
         self.documentElement = Element(env, "html")
         self.listeners: Dict[str, list] = {}
         self.pointerLockElement = None
+        self.visibilityState = "visible"
+        self.title = ""
 
     def createElement(self, tag):
         return Element(self._env, to_str(tag))
@@ -592,7 +594,12 @@ class BrowserEnv:
                     lambda t, a, i: self.resolved(JSObject({})),
                     "getUserMedia"),
             }),
+            "wakeLock": JSObject({
+                "request": NativeFunction(
+                    lambda t, a, i: self._wake_request(), "request"),
+            }),
         }))
+        self.wake_locks: List[JSObject] = []
         ws_ctor = NativeFunction(
             lambda t, a, i: FakeWebSocket(self, a[0]), "WebSocket")
         ws_ctor.OPEN = FakeWebSocket.OPEN
@@ -686,6 +693,14 @@ class BrowserEnv:
         p = JSPromise(self.interp)
         p.resolve(value)
         return p
+
+    def _wake_request(self) -> JSPromise:
+        lock = JSObject({"released": False})
+        lock.props["release"] = NativeFunction(
+            lambda t, a, i: (lock.props.__setitem__("released", True),
+                             UNDEF)[1], "release")
+        self.wake_locks.append(lock)
+        return self.resolved(lock)
 
     def _create_bitmap(self, blob) -> JSPromise:
         bmp = FakeBitmap(getattr(blob, "data", b""))

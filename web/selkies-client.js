@@ -79,6 +79,7 @@ class SelkiesClient {
 
   _onOpen() {
     this.onStatus("negotiating");
+    this._acquireWakeLock();
     if (this.claimDisplay) {
       this.send("SETTINGS," + JSON.stringify(this.settings));
     }
@@ -98,7 +99,37 @@ class SelkiesClient {
     this.onStatus("disconnected");
     if (this.ackTimer) clearInterval(this.ackTimer);
     if (this.statTimer) clearInterval(this.statTimer);
+    this._releaseWakeLock();
     this._resetDecoders();
+  }
+
+  /* Screen wake lock: a remote desktop must not dim/lock mid-session
+     (reference selkies-core.js wake-lock handling). Re-acquired when the
+     tab returns to the foreground — the UA auto-releases on hide. */
+  async _acquireWakeLock() {
+    if (!navigator.wakeLock) return;
+    try {
+      this._wakeLock = await navigator.wakeLock.request("screen");
+    } catch (e) { this._wakeLock = null; }
+    if (!this._wakeVis) {
+      this._wakeVis = () => {
+        if (document.visibilityState === "visible" && this.connected) {
+          this._acquireWakeLock();
+        }
+      };
+      document.addEventListener("visibilitychange", this._wakeVis);
+    }
+  }
+
+  _releaseWakeLock() {
+    if (this._wakeLock) {
+      try { this._wakeLock.release(); } catch (e) {}
+      this._wakeLock = null;
+    }
+    if (this._wakeVis) {
+      document.removeEventListener("visibilitychange", this._wakeVis);
+      this._wakeVis = null;
+    }
   }
 
   send(text) {
