@@ -275,6 +275,25 @@ class H264StripeEncoder:
                         ((n, 2, 4, 4, 4), 128 * n)]
         self._stripe_words = sum(s for _, s in self._shapes)
 
+        # block-sparse transfer geometry (dev._pack_sparse): fixed head +
+        # bitmap prefix, then content-sized compacted cells. The fetch
+        # prefix adapts to the previous frame's content (pipeline.py's
+        # bucket strategy) so a mostly-static desktop ships a few KB.
+        self._pad_words, self._n_cells, self._cap_cells = \
+            dev.sparse_geometry(self._stripe_words)
+        self._fixed_bytes = 4 * self.n_stripes \
+            + self.n_stripes * (self._n_cells // 8)
+        self._buf_bytes = self._fixed_bytes \
+            + self.n_stripes * self._cap_cells * dev.CELL
+        self._sparse_guess = self._bucket(self._fixed_bytes + (64 << 10))
+
+    def _bucket(self, nbytes: int) -> int:
+        """Power-of-two fetch prefix (bounds distinct slice executables)."""
+        n = 4096
+        while n < nbytes:
+            n <<= 1
+        return min(n, self._buf_bytes)
+
     # -- helpers -----------------------------------------------------------
 
     def _sps_pps_for(self, st: _StripeState) -> bytes:
@@ -287,15 +306,23 @@ class H264StripeEncoder:
 
     # -- encode ------------------------------------------------------------
 
-    def dispatch(self, rgb) -> "_H264Pending":
+    def dispatch(self, rgb, fetch: bool = True) -> "_H264Pending":
         """One dense device dispatch for the whole frame (every stripe);
         pair with :meth:`harvest`. Damage detection, reference-plane
-        selection, and i8 level packing all happen inside the single jit
-        program — the host's only per-frame read is the packed buffer."""
+        selection, and sparse level packing all happen inside the single
+        jit program — the host's only per-frame read is the packed buffer.
+
+        ``fetch=False`` skips starting the host copy; the caller owns the
+        transfer (PipelinedH264Encoder groups several frames per read)."""
         rgb = jnp.asarray(rgb)
         y, cb, cr = dev.prepare_planes(rgb, self.pad_h, self.pad_w)
 
         is_idr = any(st.need_idr for st in self.stripes)
+        if is_idr:
+            # optimistic clear so pipelined dispatch-ahead frames don't
+            # re-IDR; entropy failure at harvest re-arms the flag
+            for st in self.stripes:
+                st.need_idr = False
         paint = np.zeros(self.n_stripes, np.int8)
         if not is_idr:
             for i, st in enumerate(self.stripes):
@@ -314,34 +341,63 @@ class H264StripeEncoder:
                 self._ref_y, self._ref_cb, self._ref_cr,
                 jnp.int32(self.qp),
                 n_stripes=self.n_stripes, sh=self.stripe_h)
-            fetch = flat16
         else:
-            (flat8, flat16, self._prev_y, self._prev_cb, self._prev_cr,
-             self._ref_y, self._ref_cb, self._ref_cr) = dev.encode_frame_p(
-                y, cb, cr, self._prev_y, self._prev_cb, self._prev_cr,
-                self._ref_y, self._ref_cb, self._ref_cr,
-                jnp.asarray(paint, jnp.int32),
-                jnp.int32(self.qp), jnp.int32(self.paint_over_qp),
-                n_stripes=self.n_stripes, sh=self.stripe_h,
-                search=self.search)
-            fetch = flat8
-        fetch.copy_to_host_async()
+            (buf, flat16, self._prev_y, self._prev_cb, self._prev_cr,
+             self._ref_y, self._ref_cb, self._ref_cr) = \
+                dev.encode_frame_p_sparse(
+                    y, cb, cr, self._prev_y, self._prev_cb, self._prev_cr,
+                    self._ref_y, self._ref_cb, self._ref_cr,
+                    jnp.asarray(paint, jnp.int32),
+                    jnp.int32(self.qp), jnp.int32(self.paint_over_qp),
+                    n_stripes=self.n_stripes, sh=self.stripe_h,
+                    search=self.search)
+            pending_buf = buf
+        if is_idr:
+            pending_buf = None
+            fetch_arr = flat16 if fetch else None
+        elif fetch:
+            fetch_arr = buf[:self._sparse_guess]
+        else:
+            fetch_arr = None
+        if fetch_arr is not None:
+            fetch_arr.copy_to_host_async()
         qp_arr = np.where(paint != 0, self.paint_over_qp, self.qp)
-        return _H264Pending(fetch=fetch, flat16=flat16, is_idr=is_idr,
-                            paint=paint, qp=qp_arr)
+        return _H264Pending(fetch=fetch_arr, flat16=flat16, is_idr=is_idr,
+                            paint=paint, qp=qp_arr, buf=pending_buf)
 
-    def harvest(self, p: "_H264Pending") -> List[H264Stripe]:
+    def harvest(self, p: "_H264Pending",
+                host: Optional[np.ndarray] = None) -> List[H264Stripe]:
         """Entropy-code one dispatched frame (host CAVLC over the fetched
-        levels). Must be called in dispatch order."""
-        host = np.asarray(p.fetch)
+        levels). Must be called in dispatch order. ``host`` supplies the
+        already-fetched bytes when a pipeline owns the transfer."""
+        if host is None:
+            host = np.asarray(p.fetch)
         if p.is_idr:
             levels16 = host
             damage = np.ones(self.n_stripes, bool)
             ovf = np.zeros(self.n_stripes, bool)
         else:
             levels16 = None
-            damage = host[:, -2] != 0
-            ovf = host[:, -1] != 0
+            S = self.n_stripes
+            head = host[:4 * S].reshape(S, 4)
+            counts = head[:, 0].astype(np.int64) \
+                + (head[:, 1].astype(np.int64) << 8)
+            damage = head[:, 2] != 0
+            ovf = head[:, 3] != 0
+            used = np.minimum(counts, self._cap_cells) * dev.CELL
+            needed = self._fixed_bytes + int(used.sum())
+            if needed > len(host):
+                # guessed prefix undershot: one more fetch of the right
+                # bucket (and remember the level for the next frame)
+                full = p.buf[:self._bucket(needed)]
+                full.copy_to_host_async()
+                host = np.asarray(full)
+            self._sparse_guess = self._bucket(
+                max(needed + needed // 2, self._fixed_bytes + 4096))
+            bitmaps = host[4 * S:self._fixed_bytes] \
+                .reshape(S, self._n_cells // 8)
+            starts = np.concatenate(
+                [[0], np.cumsum(used)[:-1]]) + self._fixed_bytes
             # exact re-reads for clipped stripes, all started before any
             # blocks (rare: |level| > 127 at streaming QPs)
             refetch = {}
@@ -377,7 +433,14 @@ class H264StripeEncoder:
             elif ovf[i]:
                 row = np.asarray(refetch[i]).astype(np.int32)
             else:
-                row = host[i, :self._stripe_words].astype(np.int32)
+                # rebuild the dense row from bitmap + compacted cells
+                bits = np.unpackbits(bitmaps[i], bitorder="little")
+                idx = np.flatnonzero(bits[:self._n_cells])
+                cells = host[starts[i]:starts[i] + used[i]] \
+                    .view(np.int8).astype(np.int32).reshape(-1, dev.CELL)
+                dense = np.zeros(self._pad_words, np.int32)
+                dense.reshape(-1, dev.CELL)[idx[:len(cells)]] = cells
+                row = dense[:self._stripe_words]
             parts = []
             pos = 0
             for shape, size in self._shapes:
@@ -436,12 +499,13 @@ class H264StripeEncoder:
 
 @dataclass
 class _H264Pending:
-    """One in-flight dense H.264 dispatch."""
+    """One in-flight H.264 dispatch."""
 
-    fetch: object               # async-fetching buffer (i8 for P, i16 IDR)
-    flat16: object              # exact levels (overflow re-reads)
+    fetch: object               # async-fetching buffer (sparse u8 for P,
+    flat16: object              # i16 for IDR); exact levels for re-reads
     is_idr: bool
     paint: np.ndarray
     qp: np.ndarray
+    buf: object = None          # full sparse device buffer (undershoot)
 
 

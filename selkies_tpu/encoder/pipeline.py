@@ -239,12 +239,18 @@ class PipelinedJpegEncoder:
 
     # -- public harvest ----------------------------------------------------
 
-    def poll(self) -> List[Tuple[int, List[StripeOutput]]]:
-        """Harvest all completed frames (non-blocking, in order)."""
+    def poll(self, flush_partial: bool = True
+             ) -> List[Tuple[int, List[StripeOutput]]]:
+        """Harvest all completed frames (non-blocking, in order).
+
+        ``flush_partial`` (default) issues any partially filled fetch
+        group so frames are never stranded when submissions pause — the
+        low-latency choice for live streaming. Throughput-oriented
+        callers that poll after every submit pass False so groups only
+        ship at ``fetch_group`` size (``flush()`` remains the deadline).
+        """
         out, self._ready = self._ready, []
-        # a partial fetch group must not strand frames when submissions
-        # pause: polling is the deadline that flushes it
-        if self._unfetched:
+        if self._unfetched and flush_partial:
             self._issue_fetch()
         self._advance_ready()
         while self._inflight and self._advance(self._inflight[0], block=False):
@@ -347,3 +353,136 @@ class ThreadedEncoderAdapter:
         self._pool.shutdown(wait=False, cancel_futures=True)
         self._pending.clear()
         self._done.clear()
+
+
+@dataclass
+class _H264InFlight:
+    seq: int
+    pending: Any                     # h264._H264Pending
+    group: Any = None                # _FetchGroup (P frames)
+    group_index: int = 0
+    host: Optional[np.ndarray] = None
+
+
+class PipelinedH264Encoder:
+    """Depth-N pipelined wrapper around H264StripeEncoder with grouped
+    sparse-buffer fetches.
+
+    Same transfer economics as PipelinedJpegEncoder: an RPC-attached
+    device pays ~25-110 ms per D2H read regardless of size, so several
+    frames' sparse level buffers (h264_device._pack_sparse) are
+    concatenated on device and fetched in ONE read. IDR frames carry the
+    full flat16 levels and fetch solo (they are rare: connect/reset/PLI).
+    """
+
+    def __init__(self, base, depth: int = 8, fetch_group: int = 4) -> None:
+        self.base = base
+        self.depth = depth
+        self.fetch_group = max(1, fetch_group)
+        self._inflight: deque[_H264InFlight] = deque()
+        self._unfetched: List[_H264InFlight] = []
+        self._ready: List[Tuple[int, list]] = []
+        self._seq = 0
+
+    @property
+    def n_inflight(self) -> int:
+        return len(self._inflight)
+
+    def request_keyframe(self) -> None:
+        self.base.request_keyframe()
+
+    force_keyframe = request_keyframe
+
+    @property
+    def qp(self):
+        return self.base.qp
+
+    @qp.setter
+    def qp(self, value):
+        self.base.qp = value
+
+    def try_submit(self, frame) -> Optional[int]:
+        if len(self._inflight) >= self.depth:
+            return None
+        return self.submit(frame)
+
+    def submit(self, frame) -> int:
+        while len(self._inflight) >= self.depth:
+            self._ready.append(self._drain_one())
+        p = self.base.dispatch(frame, fetch=False)
+        item = _H264InFlight(seq=self._seq, pending=p)
+        self._seq += 1
+        self._inflight.append(item)
+        if p.is_idr:
+            # IDR fetches flat16 solo (rare: connect/reset/PLI)
+            p.flat16.copy_to_host_async()
+        else:
+            self._unfetched.append(item)
+            if len(self._unfetched) >= self.fetch_group:
+                self._issue_fetch()
+        return item.seq
+
+    def _issue_fetch(self) -> None:
+        group_items, self._unfetched = self._unfetched, []
+        if not group_items:
+            return
+        stride = self.base._sparse_guess
+        slices = [it.pending.buf[:stride] for it in group_items]
+        arr = slices[0] if len(slices) == 1 else jnp.concatenate(slices)
+        arr.copy_to_host_async()
+        group = _FetchGroup(arr=arr, stride=stride)
+        for i, it in enumerate(group_items):
+            it.group = group
+            it.group_index = i
+
+    def _advance(self, item: _H264InFlight, block: bool) -> bool:
+        p = item.pending
+        if p.is_idr:
+            if not block and not p.flat16.is_ready():
+                return False
+            if item.host is None:
+                item.host = np.asarray(p.flat16)
+            return True
+        if item.group is None:
+            if not block:
+                return False
+            self._issue_fetch()
+        if not block and not item.group.arr.is_ready():
+            return False
+        if item.group.host is None:
+            item.group.host = np.asarray(item.group.arr)
+        stride = item.group.stride
+        item.host = item.group.host[item.group_index * stride:
+                                    (item.group_index + 1) * stride]
+        return True
+
+    def _drain_one(self) -> Tuple[int, list]:
+        # harvest() mutates per-stripe frame_num/static history, so frames
+        # complete strictly in submission order (deque head first)
+        item = self._inflight.popleft()
+        self._advance(item, block=True)
+        return item.seq, self.base.harvest(item.pending, host=item.host)
+
+    def poll(self, flush_partial: bool = True) -> List[Tuple[int, list]]:
+        """Harvest completed frames in order; see PipelinedJpegEncoder.poll
+        for the ``flush_partial`` latency/throughput trade."""
+        out, self._ready = self._ready, []
+        if self._unfetched and flush_partial:
+            self._issue_fetch()
+        while self._inflight and self._advance(self._inflight[0],
+                                               block=False):
+            item = self._inflight.popleft()
+            out.append((item.seq,
+                        self.base.harvest(item.pending, host=item.host)))
+        return out
+
+    def flush(self) -> List[Tuple[int, list]]:
+        out, self._ready = self._ready, []
+        while self._inflight:
+            out.append(self._drain_one())
+        return out
+
+    def close(self) -> None:
+        self._inflight.clear()
+        self._unfetched.clear()
+        self._ready.clear()

@@ -29,13 +29,18 @@ _YCC_OFFSET = jnp.array([0.0, 128.0, 128.0], dtype=jnp.float32)
 def rgb_to_ycbcr(rgb):
     """[..., H, W, 3] uint8/float RGB → (Y, Cb, Cr) float32 planes [..., H, W].
 
-    Values are in [0, 255]; no level shift here (the DCT stage subtracts 128).
+    Values are in [0, 255]; no level shift here (the DCT stage subtracts
+    128). Elementwise FMA form, not a matmul: a [N, 3] @ [3, 3] dot is the
+    worst possible MXU shape (and at HIGHEST precision costs 6 passes) —
+    the VPU does this in one fused pass per plane.
     """
     x = rgb.astype(jnp.float32)
-    ycc = jnp.einsum(
-        "...hwc,oc->...hwo", x, _RGB2YCC, precision=jax.lax.Precision.HIGHEST
-    ) + _YCC_OFFSET
-    return ycc[..., 0], ycc[..., 1], ycc[..., 2]
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    m = _RGB2YCC
+    y = m[0, 0] * r + m[0, 1] * g + m[0, 2] * b
+    cb = m[1, 0] * r + m[1, 1] * g + m[1, 2] * b + 128.0
+    cr = m[2, 0] * r + m[2, 1] * g + m[2, 2] * b + 128.0
+    return y, cb, cr
 
 
 def subsample_420(plane):

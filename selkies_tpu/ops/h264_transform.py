@@ -111,10 +111,32 @@ def blocks_to_plane(blocks: jnp.ndarray) -> jnp.ndarray:
 # forward/inverse core transform
 
 
+def _cf_1d(x0, x1, x2, x3):
+    """One 1-D core-transform butterfly (rows of Cf applied to a lane)."""
+    s0 = x0 + x3
+    s1 = x1 + x2
+    d0 = x0 - x3
+    d1 = x1 - x2
+    return s0 + s1, 2 * d0 + d1, s0 - s1, d0 - 2 * d1
+
+
 def forward_dct4(blocks: jnp.ndarray) -> jnp.ndarray:
-    """Core transform W = Cf · X · Cfᵀ over (..., 4, 4) int32 blocks."""
-    cf = jnp.asarray(_CF)
-    return jnp.einsum("ij,...jk,lk->...il", cf, blocks.astype(jnp.int32), cf)
+    """Core transform W = Cf · X · Cfᵀ over (..., 4, 4) int32 blocks.
+
+    Butterfly form (adds/shifts on whole lanes), not an einsum: TPU has
+    no integer MXU path, so a batched 4×4 int dot lowers to slow
+    scalar/loop code — the same reason inverse_dct4 is written as
+    butterflies.
+    """
+    x = blocks.astype(jnp.int32)
+    # vertical (left multiply): combine rows
+    v0, v1, v2, v3 = _cf_1d(x[..., 0, :], x[..., 1, :],
+                            x[..., 2, :], x[..., 3, :])
+    v = jnp.stack([v0, v1, v2, v3], axis=-2)
+    # horizontal (right multiply by Cfᵀ): combine columns
+    h0, h1, h2, h3 = _cf_1d(v[..., :, 0], v[..., :, 1],
+                            v[..., :, 2], v[..., :, 3])
+    return jnp.stack([h0, h1, h2, h3], axis=-1)
 
 
 def inverse_dct4(coeffs: jnp.ndarray) -> jnp.ndarray:
@@ -176,10 +198,25 @@ def dequant4(levels: jnp.ndarray, qp: jnp.ndarray) -> jnp.ndarray:
 # Intra16x16 luma DC path
 
 
+def _h4_1d(x0, x1, x2, x3):
+    """One 1-D 4-point Hadamard butterfly (rows of _H4)."""
+    a = x0 + x1
+    b = x2 + x3
+    c = x0 - x1
+    e = x2 - x3
+    return a + b, a - b, c - e, c + e
+
+
 def hadamard4_fwd(dc: jnp.ndarray) -> jnp.ndarray:
-    """Encoder DC transform: (H·X·Hᵀ)/2 over (..., 4, 4)."""
-    h = jnp.asarray(_H4)
-    y = jnp.einsum("ij,...jk,lk->...il", h, dc.astype(jnp.int32), h)
+    """Encoder DC transform: (H·X·Hᵀ)/2 over (..., 4, 4), butterfly form
+    (no integer einsum — see forward_dct4)."""
+    x = dc.astype(jnp.int32)
+    v0, v1, v2, v3 = _h4_1d(x[..., 0, :], x[..., 1, :],
+                            x[..., 2, :], x[..., 3, :])
+    v = jnp.stack([v0, v1, v2, v3], axis=-2)
+    h0, h1, h2, h3 = _h4_1d(v[..., :, 0], v[..., :, 1],
+                            v[..., :, 2], v[..., :, 3])
+    y = jnp.stack([h0, h1, h2, h3], axis=-1)
     return y >> 1  # /2 per spec encoder convention (x264 does the same)
 
 
@@ -211,8 +248,13 @@ def dequant_dc16(levels: jnp.ndarray, qp: jnp.ndarray) -> jnp.ndarray:
     """Decoder §8.5.10 exactly: inverse Hadamard FIRST, then scale with
     LevelScale = 16·V (flat default scaling list)."""
     qp = jnp.asarray(qp, jnp.int32)
-    h = jnp.asarray(_H4)
-    f = jnp.einsum("ij,...jk,lk->...il", h, levels.astype(jnp.int32), h)
+    x = levels.astype(jnp.int32)
+    v0, v1, v2, v3 = _h4_1d(x[..., 0, :], x[..., 1, :],
+                            x[..., 2, :], x[..., 3, :])
+    v = jnp.stack([v0, v1, v2, v3], axis=-2)
+    h0, h1, h2, h3 = _h4_1d(v[..., :, 0], v[..., :, 1],
+                            v[..., :, 2], v[..., :, 3])
+    f = jnp.stack([h0, h1, h2, h3], axis=-1)
     ls = jnp.asarray(V_TABLE)[qp % 6, 0, 0] * 16
     shift = qp // 6
     hi = (f * ls) << jnp.maximum(shift - 6, 0)
@@ -225,10 +267,21 @@ def dequant_dc16(levels: jnp.ndarray, qp: jnp.ndarray) -> jnp.ndarray:
 # chroma DC path (2×2)
 
 
+def _h2_2d(x):
+    """H2 · X · H2 over (..., 2, 2) as adds (H2 is its own transpose)."""
+    a = x[..., 0, 0]
+    b = x[..., 0, 1]
+    c = x[..., 1, 0]
+    d = x[..., 1, 1]
+    return jnp.stack([
+        jnp.stack([a + b + c + d, a - b + c - d], axis=-1),
+        jnp.stack([a + b - c - d, a - b - c + d], axis=-1),
+    ], axis=-2)
+
+
 def hadamard2_fwd(dc: jnp.ndarray) -> jnp.ndarray:
     """Encoder chroma DC transform over (..., 2, 2) (no scaling)."""
-    h = jnp.asarray(_H2)
-    return jnp.einsum("ij,...jk,lk->...il", h, dc.astype(jnp.int32), h)
+    return _h2_2d(dc.astype(jnp.int32))
 
 
 def quant_dc2(dc_t: jnp.ndarray, qpc: jnp.ndarray) -> jnp.ndarray:
@@ -251,8 +304,7 @@ def dequant_dc2(levels: jnp.ndarray, qpc: jnp.ndarray) -> jnp.ndarray:
     """Decoder §8.5.11 exactly: inverse 2×2 Hadamard then
     ((f·16·V)<<(qp/6))>>5 (LevelScale = 16·V, flat scaling list)."""
     qpc = jnp.asarray(qpc, jnp.int32)
-    h = jnp.asarray(_H2)
-    f = jnp.einsum("ij,...jk,lk->...il", h, levels.astype(jnp.int32), h)
+    f = _h2_2d(levels.astype(jnp.int32))
     ls = jnp.asarray(V_TABLE)[qpc % 6, 0, 0] * 16
     return ((f * ls) << (qpc // 6)) >> 5
 

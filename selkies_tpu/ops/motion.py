@@ -116,6 +116,89 @@ def full_search_mv(cur: jnp.ndarray, ref: jnp.ndarray, *,
 
 
 @functools.partial(jax.jit, static_argnames=("mb", "search"))
+def full_search_mc(cur, ref, ref_cb, ref_cr, *, mb: int = 16,
+                   search: int = 12):
+    """Fused exhaustive ME + luma/chroma MC in ONE scan over offsets.
+
+    The separate ME → mc_luma/mc_chroma pipeline pays per-macroblock
+    gathers (vmapped dynamic_slice with per-block starts): ~3M gathered
+    elements/frame through the TPU scalar core dominated the whole H.264
+    encode (~90-110 ms each at 1080p). Here every candidate offset is a
+    single dynamic-base slice (a DMA, not a gather), and the winning
+    prediction — luma and the §8.4.2.2.2-exact chroma bilinear — is
+    selected with elementwise masks inside the same scan, so NO
+    per-block random access exists anywhere in the P-frame path.
+
+    Tie-breaking matches full_search_mv exactly: offsets scan in
+    |dy|+|dx|-sorted order and a strict ``<`` keeps the earliest
+    minimum, so (0,0) and near-zero motion win ties.
+
+    Returns (mv, pred_y u8, pred_cb u8, pred_cr u8).
+    """
+    h, w = cur.shape[-2:]
+    hc, wc = ref_cb.shape[-2:]
+    cb2 = mb // 2
+    nby, nbx = h // mb, w // mb
+    offs_np = _offsets(search)
+    offs = jnp.asarray(offs_np)
+    cur_i = cur.astype(jnp.int16)
+    ref_pad = pad_replicate(ref.astype(jnp.int16), search)
+    rc = search // 2 + 1
+    cbp = pad_replicate(ref_cb.astype(jnp.int32), rc + 1)
+    crp = pad_replicate(ref_cr.astype(jnp.int32), rc + 1)
+
+    def chroma_pred(cp, off):
+        iy = off[0] >> 1
+        ix = off[1] >> 1
+        yf = (off[0] & 1) * 4
+        xf = (off[1] & 1) * 4
+        starts = (0,) * (cp.ndim - 2) + (rc + 1 + iy, rc + 1 + ix)
+        a = jax.lax.dynamic_slice(
+            cp, starts, cp.shape[:-2] + (hc + 1, wc + 1))
+        tl = a[..., :hc, :wc]
+        tr = a[..., :hc, 1:]
+        bl = a[..., 1:, :wc]
+        br = a[..., 1:, 1:]
+        return ((8 - xf) * (8 - yf) * tl + xf * (8 - yf) * tr +
+                (8 - xf) * yf * bl + xf * yf * br + 32) >> 6
+
+    def block_px(mask, cell):
+        return jnp.repeat(jnp.repeat(mask, cell, -2), cell, -1)
+
+    def body(carry, xs):
+        best_sad, best_idx, py, pcb, pcr = carry
+        off, idx = xs
+        starts = (0,) * (ref_pad.ndim - 2) + (search + off[0],
+                                              search + off[1])
+        shifted = jax.lax.dynamic_slice(
+            ref_pad, starts, ref_pad.shape[:-2] + (h, w))
+        sad = _sad_per_mb(jnp.abs(cur_i - shifted).astype(jnp.int32), mb)
+        take = sad < best_sad
+        ncb = chroma_pred(cbp, off)
+        ncr = chroma_pred(crp, off)
+        tpx = block_px(take, mb)
+        tcx = block_px(take, cb2)
+        return ((jnp.where(take, sad, best_sad),
+                 jnp.where(take, idx, best_idx),
+                 jnp.where(tpx, shifted, py),
+                 jnp.where(tcx, ncb, pcb),
+                 jnp.where(tcx, ncr, pcr)), None)
+
+    lead = cur.shape[:-2]
+    init = (jnp.full(lead + (nby, nbx), 2 ** 30, jnp.int32),
+            jnp.zeros(lead + (nby, nbx), jnp.int32),
+            jnp.zeros(lead + (h, w), jnp.int16),
+            jnp.zeros(lead + (hc, wc), jnp.int32),
+            jnp.zeros(lead + (hc, wc), jnp.int32))
+    n = offs.shape[0]
+    (best_sad, best_idx, py, pcb, pcr), _ = jax.lax.scan(
+        body, init, (offs, jnp.arange(n, dtype=jnp.int32)))
+    mv = offs[best_idx]                              # tiny [nby, nbx] take
+    return (mv, py.astype(jnp.uint8), pcb.astype(jnp.uint8),
+            pcr.astype(jnp.uint8))
+
+
+@functools.partial(jax.jit, static_argnames=("mb", "search"))
 def mc_luma(ref: jnp.ndarray, mv: jnp.ndarray, *,
             mb: int = 16, search: int = 12) -> jnp.ndarray:
     """Motion-compensated luma prediction.

@@ -147,3 +147,75 @@ def test_batched_search_over_stripes():
     mv, sad0, best = full_search_mv(stripes, stripes, search=4)
     assert np.asarray(mv).shape == (3, 2, 4, 2)
     assert (np.asarray(best) == 0).all()
+
+
+def test_pipelined_h264_matches_synchronous():
+    """PipelinedH264Encoder (grouped sparse fetches) must produce the
+    byte-identical stream the synchronous encoder does."""
+    import numpy as np
+    from selkies_tpu.encoder.h264 import H264StripeEncoder
+    from selkies_tpu.encoder.pipeline import PipelinedH264Encoder
+
+    rng = np.random.default_rng(5)
+
+    def frame(t, h=96, w=160):
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        base = 128 + 80 * np.sin((xx + 7 * t) / 23) * np.cos(yy / 17)
+        f = np.clip(np.stack([base, base + 10, base - 10], -1),
+                    0, 255).astype(np.uint8)
+        return f
+
+    a = H264StripeEncoder(160, 96, stripe_height=32, qp=24)
+    b = H264StripeEncoder(160, 96, stripe_height=32, qp=24)
+    pipe = PipelinedH264Encoder(b, depth=6, fetch_group=3)
+
+    want = []
+    for t in range(8):
+        want.append([(s.y_start, s.is_key, s.annexb)
+                     for s in a.encode_frame(frame(t))])
+    got_frames = {}
+    for t in range(8):
+        pipe.submit(frame(t))
+        for seq, stripes in pipe.poll():
+            got_frames[seq] = stripes
+    for seq, stripes in pipe.flush():
+        got_frames[seq] = stripes
+    assert len(got_frames) == 8
+    for t in range(8):
+        got = [(s.y_start, s.is_key, s.annexb) for s in got_frames[t]]
+        assert got == want[t], f"frame {t} diverged"
+
+
+def test_sparse_pack_roundtrip_exact():
+    """Device sparse pack vs the dense flat16 it summarizes."""
+    import jax.numpy as jnp
+    import numpy as np
+    from selkies_tpu.encoder import h264_device as dev
+
+    rng = np.random.default_rng(9)
+    S, W = 3, 5000
+    flat = np.zeros((S, W), np.int16)
+    # sparse content + one dense stripe + one |level|>127 stripe
+    for i in range(40):
+        flat[0, rng.integers(0, W)] = rng.integers(-100, 100)
+    flat[1, :] = rng.integers(-5, 5, W)            # count overflow
+    flat[2, 100] = 300                             # range overflow
+    damage = jnp.asarray([True, True, True])
+    buf = np.asarray(dev._pack_sparse(
+        jnp.asarray(flat), damage, damage, cap_frac=4))
+    pad_words, n_cells, cap = dev.sparse_geometry(W)
+    head = buf[:4 * S].reshape(S, 4)
+    counts = head[:, 0].astype(int) + (head[:, 1].astype(int) << 8)
+    ovf = head[:, 3] != 0
+    assert not ovf[0] and ovf[1] and ovf[2]
+    fixed = 4 * S + S * (n_cells // 8)
+    bitmaps = buf[4 * S:fixed].reshape(S, n_cells // 8)
+    used = np.minimum(counts, cap) * dev.CELL
+    starts = np.concatenate([[0], np.cumsum(used)[:-1]]) + fixed
+    bits = np.unpackbits(bitmaps[0], bitorder="little")[:n_cells]
+    idx = np.flatnonzero(bits)
+    cells = buf[starts[0]:starts[0] + used[0]].view(np.int8) \
+        .astype(np.int32).reshape(-1, dev.CELL)
+    dense = np.zeros(pad_words, np.int32)
+    dense.reshape(-1, dev.CELL)[idx[:len(cells)]] = cells
+    np.testing.assert_array_equal(dense[:W], flat[0])

@@ -87,3 +87,36 @@ def test_quantize_and_zigzag():
     assert (np.asarray(q) == 2).all()
     z = zigzag_blocks(q)
     assert z.shape == (2, 2, 64)
+
+
+def test_full_search_mc_matches_separate_path():
+    """The fused ME+MC scan must reproduce full_search_mv + mc_luma +
+    mc_chroma exactly (mv tie-breaks included)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from selkies_tpu.ops.motion import (full_search_mc, full_search_mv,
+                                        mc_chroma, mc_luma)
+
+    rng = np.random.default_rng(11)
+    h, w = 64, 96
+    ref = rng.integers(0, 256, (h, w), dtype=np.uint8)
+    # shifted + noisy current frame exercises real motion
+    cur = np.roll(ref, (3, -5), axis=(0, 1))
+    cur = np.clip(cur.astype(np.int32)
+                  + rng.integers(-6, 7, cur.shape), 0, 255).astype(np.uint8)
+    ref_cb = rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+    ref_cr = rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8)
+
+    mv_want, _, _ = full_search_mv(jnp.asarray(cur), jnp.asarray(ref),
+                                   search=8)
+    py_want = mc_luma(jnp.asarray(ref), mv_want, search=8)
+    pcb_want = mc_chroma(jnp.asarray(ref_cb), mv_want, search=8)
+    pcr_want = mc_chroma(jnp.asarray(ref_cr), mv_want, search=8)
+
+    mv, py, pcb, pcr = full_search_mc(
+        jnp.asarray(cur), jnp.asarray(ref), jnp.asarray(ref_cb),
+        jnp.asarray(ref_cr), search=8)
+    np.testing.assert_array_equal(np.asarray(mv), np.asarray(mv_want))
+    np.testing.assert_array_equal(np.asarray(py), np.asarray(py_want))
+    np.testing.assert_array_equal(np.asarray(pcb), np.asarray(pcb_want))
+    np.testing.assert_array_equal(np.asarray(pcr), np.asarray(pcr_want))

@@ -79,6 +79,7 @@ class SelkiesClient {
 
   _onOpen() {
     this.onStatus("negotiating");
+    this.connected = true;
     this._acquireWakeLock();
     if (this.claimDisplay) {
       this.send("SETTINGS," + JSON.stringify(this.settings));
@@ -109,7 +110,14 @@ class SelkiesClient {
   async _acquireWakeLock() {
     if (!navigator.wakeLock) return;
     try {
-      this._wakeLock = await navigator.wakeLock.request("screen");
+      const lock = await navigator.wakeLock.request("screen");
+      // the connection may have closed while the request was pending —
+      // a late resolve must not resurrect a lock release() already ended
+      if (!this.connected) {
+        try { lock.release(); } catch (e) {}
+        return;
+      }
+      this._wakeLock = lock;
     } catch (e) { this._wakeLock = null; }
     if (!this._wakeVis) {
       this._wakeVis = () => {
