@@ -63,7 +63,10 @@ class WebRTCStreamingApp:
         self.interfaces = interfaces
         self.width = getattr(settings, "initial_width", 1280)
         self.height = getattr(settings, "initial_height", 720)
-        self.framerate = float(getattr(settings, "framerate", 60))
+        # the real Settings exposes framerate as a RangeValue (allowed
+        # range + default); plain numbers (tests, embedders) pass through
+        fr = getattr(settings, "framerate", 60)
+        self.framerate = float(getattr(fr, "default", fr))
         self.encoder_factory = encoder_factory or self._default_encoder
         self.source_factory = source_factory or self._default_source
         self.audio_settings = audio_settings or AudioCaptureSettings()
@@ -159,19 +162,42 @@ class WebRTCStreamingApp:
         await self.pc.wait_connected()
         interval = 1.0 / self.framerate
         t0 = time.monotonic()
+        # dispatch/harvest-capable encoders run pipelined so device
+        # latency hides behind the frame interval; fakes/others stay
+        # synchronous
+        pipe = None
+        if hasattr(self.encoder, "dispatch"):
+            from ..encoder.pipeline import PipelinedH264Encoder
+
+            pipe = PipelinedH264Encoder(self.encoder, depth=3,
+                                        fetch_group=1)
+
+        def _send(stripes) -> None:
+            if not stripes:
+                return
+            au = b"".join(s.annexb for s in stripes)
+            ts = int((time.monotonic() - t0) * VIDEO_CLOCK)
+            self.video_sender.send_frame(au, ts)
+            self.frames_sent += 1
+
         while self._running:
             start = time.monotonic()
             frame = self.source.next_frame()
             if frame is not None:
-                stripes = await asyncio.to_thread(
-                    self.encoder.encode_frame, frame)
-                if stripes:
-                    au = b"".join(s.annexb for s in stripes)
-                    ts = int((time.monotonic() - t0) * VIDEO_CLOCK)
-                    self.video_sender.send_frame(au, ts)
-                    self.frames_sent += 1
+                if pipe is None:
+                    _send(await asyncio.to_thread(
+                        self.encoder.encode_frame, frame))
+                else:
+                    def tick(f=frame):
+                        pipe.try_submit(f)      # full pipeline drops, not
+                        return pipe.poll()      # blocks (shared loop)
+                    for _seq, stripes in await asyncio.to_thread(tick):
+                        _send(stripes)
             elapsed = time.monotonic() - start
             await asyncio.sleep(max(0.0, interval - elapsed))
+        if pipe is not None:
+            for _seq, stripes in await asyncio.to_thread(pipe.flush):
+                _send(stripes)
 
     async def _audio_loop(self) -> None:
         await self.pc.wait_connected()
