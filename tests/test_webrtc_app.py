@@ -144,3 +144,16 @@ def test_webrtc_app_full_session():
             t.cancel()
 
     asyncio.run(run())
+
+
+def test_app_constructs_with_real_settings():
+    """Regression: the production entry passes the REAL Settings (where
+    framerate is a RangeValue); construction must not raise — the local
+    Settings stub above masked a float(RangeValue) crash that broke
+    selkies-tpu-webrtc at startup."""
+    from selkies_tpu.settings import Settings as RealSettings
+
+    app = WebRTCStreamingApp(RealSettings(argv=[], env={}),
+                             input_handler=RecordingInput(),
+                             interfaces=["127.0.0.1"])
+    assert app.framerate == 60.0
