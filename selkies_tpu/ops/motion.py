@@ -210,24 +210,26 @@ def full_search_mc(cur, ref, ref_cb, ref_cr, *, mb: int = 16,
         ncr = chroma_pred(crp, off)
         tpx = block_px(take, mb)
         tcx = block_px(take, cb2)
+        # uint8 carries: every prediction value is ≤ 255, and the scan
+        # re-reads + re-writes the carries each of the 625 iterations —
+        # carry bytes are the dominant HBM traffic of the whole search
         return ((jnp.where(take, sad, best_sad),
                  jnp.where(take, idx, best_idx),
-                 jnp.where(tpx, shifted.astype(jnp.int16), py),
-                 jnp.where(tcx, ncb, pcb),
-                 jnp.where(tcx, ncr, pcr)), None)
+                 jnp.where(tpx, shifted.astype(jnp.uint8), py),
+                 jnp.where(tcx, ncb.astype(jnp.uint8), pcb),
+                 jnp.where(tcx, ncr.astype(jnp.uint8), pcr)), None)
 
     lead = cur.shape[:-2]
     init = (jnp.full(lead + (nby, nbx), jnp.inf, jnp.float32),
             jnp.zeros(lead + (nby, nbx), jnp.int32),
-            jnp.zeros(lead + (h, w), jnp.int16),
-            jnp.zeros(lead + (hc, wc), jnp.int32),
-            jnp.zeros(lead + (hc, wc), jnp.int32))
+            jnp.zeros(lead + (h, w), jnp.uint8),
+            jnp.zeros(lead + (hc, wc), jnp.uint8),
+            jnp.zeros(lead + (hc, wc), jnp.uint8))
     n = offs.shape[0]
     (best_sad, best_idx, py, pcb, pcr), _ = jax.lax.scan(
         body, init, (offs, jnp.arange(n, dtype=jnp.int32)))
     mv = offs[best_idx]                              # tiny [nby, nbx] take
-    return (mv, py.astype(jnp.uint8), pcb.astype(jnp.uint8),
-            pcr.astype(jnp.uint8))
+    return mv, py, pcb, pcr
 
 
 @functools.partial(jax.jit, static_argnames=("mb", "search"))
