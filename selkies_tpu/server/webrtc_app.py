@@ -160,7 +160,6 @@ class WebRTCStreamingApp:
 
     async def _video_loop(self) -> None:
         await self.pc.wait_connected()
-        interval = 1.0 / self.framerate
         t0 = time.monotonic()
         # dispatch/harvest-capable encoders run pipelined so device
         # latency hides behind the frame interval; fakes/others stay
@@ -172,32 +171,49 @@ class WebRTCStreamingApp:
             pipe = PipelinedH264Encoder(self.encoder, depth=3,
                                         fetch_group=1)
 
-        def _send(stripes) -> None:
-            if not stripes:
+        def _send(seq: int, stripes) -> None:
+            if not stripes or not self._running:
                 return
             au = b"".join(s.annexb for s in stripes)
-            ts = int((time.monotonic() - t0) * VIDEO_CLOCK)
+            # timestamps advance per encoded frame, not per wall-clock
+            # send instant: poll() can deliver several frames in one tick
+            # and identical RTP timestamps would merge distinct AUs
+            ts = int(seq * VIDEO_CLOCK / max(self.framerate, 1.0))
             self.video_sender.send_frame(au, ts)
             self.frames_sent += 1
 
-        while self._running:
-            start = time.monotonic()
-            frame = self.source.next_frame()
-            if frame is not None:
+        sync_seq = 0
+        try:
+            while self._running:
+                start = time.monotonic()
+                frame = self.source.next_frame()
                 if pipe is None:
-                    _send(await asyncio.to_thread(
-                        self.encoder.encode_frame, frame))
+                    if frame is not None:
+                        stripes = await asyncio.to_thread(
+                            self.encoder.encode_frame, frame)
+                        _send(sync_seq, stripes)
+                        sync_seq += 1
                 else:
+                    # poll-then-submit every tick: completed frames ship
+                    # even when capture hiccups, and draining first frees
+                    # a pipeline slot the new frame would otherwise lose
                     def tick(f=frame):
-                        pipe.try_submit(f)      # full pipeline drops, not
-                        return pipe.poll()      # blocks (shared loop)
-                    for _seq, stripes in await asyncio.to_thread(tick):
-                        _send(stripes)
-            elapsed = time.monotonic() - start
-            await asyncio.sleep(max(0.0, interval - elapsed))
-        if pipe is not None:
-            for _seq, stripes in await asyncio.to_thread(pipe.flush):
-                _send(stripes)
+                        done = pipe.poll()
+                        if f is not None:
+                            pipe.try_submit(f)
+                        return done
+                    for seq, stripes in await asyncio.to_thread(tick):
+                        _send(seq, stripes)
+                elapsed = time.monotonic() - start
+                await asyncio.sleep(
+                    max(0.0, 1.0 / max(self.framerate, 1.0) - elapsed))
+        finally:
+            if pipe is not None:
+                # teardown arrives as a task cancellation: drain what the
+                # device already produced (sends are gated on _running)
+                for seq, stripes in await asyncio.shield(
+                        asyncio.to_thread(pipe.flush)):
+                    _send(seq, stripes)
 
     async def _audio_loop(self) -> None:
         await self.pc.wait_connected()
