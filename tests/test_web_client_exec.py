@@ -600,3 +600,28 @@ def test_wake_lock_lifecycle(env):
     assert len(env.wake_locks) == n0 + 1
     env.call(env.get(client, "disconnect"), [])
     assert env.wake_locks[-1].props["released"] is True
+
+
+def test_upload_file_chunks_and_frames(env):
+    """uploadFile: START/chunk/END protocol with 0x01-framed binary and
+    the bufferedAmount backpressure loop."""
+    client, ws, canvas = make_client(env)
+    from web_stubs import FakeBlobSlice
+    from tools.minijs import NativeFunction
+
+    data = bytes(range(256)) * 1200          # 300 KB → 2 chunks @ 256 KB
+
+    class FakeFile:
+        name = "report.pdf"
+        size = float(len(data))
+
+        def slice(self, a, b):
+            return FakeBlobSlice(env, data[int(to_num(a)):int(to_num(b))])
+
+    env.call(env.get(client, "uploadFile"), [FakeFile()], this=client)
+    texts = ws.texts()
+    assert f"FILE_UPLOAD_START:report.pdf:{len(data)}" in texts
+    assert "FILE_UPLOAD_END:report.pdf" in texts
+    bins = [b for b in ws.sent if isinstance(b, bytes) and b[:1] == b"\x01"]
+    assert len(bins) == 2                    # 256 KB + 44 KB
+    assert b"".join(b[1:] for b in bins) == data
