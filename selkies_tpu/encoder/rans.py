@@ -131,13 +131,23 @@ def model_header(freqs: np.ndarray) -> bytes:
 
 def parse_model_header(data: bytes, alphabet: int = 268
                        ) -> Tuple[np.ndarray, int]:
+    if len(data) < 2:
+        raise ValueError("malformed rANS stream: header truncated")
     (n,) = struct.unpack_from("<H", data)
+    if 2 + 4 * n > len(data):
+        raise ValueError("malformed rANS stream: model table truncated")
     freqs = np.zeros(alphabet, np.int64)
     pos = 2
     for _ in range(n):
         s, f = struct.unpack_from("<HH", data, pos)
+        if s >= alphabet:
+            raise ValueError(f"malformed rANS stream: symbol {s} outside "
+                             f"alphabet {alphabet}")
         freqs[s] = f
         pos += 4
+    if int(freqs.sum()) != PROB_SCALE:
+        raise ValueError("malformed rANS stream: model does not sum to "
+                         "PROB_SCALE")
     return freqs.astype(np.uint16), pos
 
 
@@ -172,8 +182,10 @@ def rans_decode(data: bytes, freqs: np.ndarray, count: int) -> np.ndarray:
     slot2sym = np.zeros(PROB_SCALE, np.int32)
     for s in np.flatnonzero(freqs):
         slot2sym[cum[s]:cum[s + 1]] = s
+    if len(data) < 4:
+        raise ValueError("malformed rANS stream: state header truncated")
     (state,) = struct.unpack_from("<I", data)
-    words = np.frombuffer(data[4:], np.uint16)
+    words = np.frombuffer(data[4:len(data) - (len(data) - 4) % 2], np.uint16)
     wi = 0
     out = np.empty(count, np.int32)
     for i in range(count):
@@ -207,6 +219,8 @@ def pack_value_bits(vbits: np.ndarray, vlens: np.ndarray) -> bytes:
 
 
 def unpack_value_bits(data: bytes, vlens: np.ndarray) -> np.ndarray:
+    if int(vlens.sum() if len(vlens) else 0) > len(data) * 8:
+        raise ValueError("malformed rANS stream: value bits truncated")
     out = np.empty(len(vlens), np.int64)
     pos = 0
     for i, ln in enumerate(vlens.tolist()):
@@ -250,8 +264,18 @@ def decode_planes(data: bytes, y_blocks: int, c_blocks: int,
     planes = []
     for n_blocks, reset in ((y_blocks, blocks_per_stripe_y),
                             (c_blocks, max(1, blocks_per_stripe_y // 4))):
+        if pos + 12 > len(data):
+            raise ValueError("malformed rANS stream: plane header truncated")
         nsym, nstream, nvalues = struct.unpack_from("<III", data, pos)
         pos += 12
+        if pos + nstream + nvalues > len(data):
+            raise ValueError("malformed rANS stream: plane sizes exceed data")
+        # a block emits ≤ 65 symbols (DC + 64 AC/EOB) and ≤ 65 values, so
+        # an untrusted 32-bit count beyond that is an attack, not a frame —
+        # without this bound a ~30-byte blob forces a multi-GB allocation
+        # and a near-unbounded decode loop
+        if nsym > n_blocks * 65 or nvalues > n_blocks * 65 * 8:
+            raise ValueError("malformed rANS stream: counts exceed geometry")
         freqs, consumed = parse_model_header(data[pos:])
         pos += consumed
         syms = rans_decode(data[pos:pos + nstream], freqs, nsym)
@@ -268,15 +292,31 @@ def decode_planes(data: bytes, y_blocks: int, c_blocks: int,
         vlens_arr = np.asarray([l for l in vlens if l > 0], np.int32)
         vals = unpack_value_bits(values_raw, vlens_arr)
         blocks = np.zeros((n_blocks, 64), np.int16)
+        n_syms = len(syms)
+        n_vals = len(vals)
         vi = 0
         si = 0
         pred = 0
+
+        def _bad(what: str) -> ValueError:
+            # corrupt/truncated input must surface as a clean decode
+            # error, not an IndexError, before this coder ever fronts
+            # untrusted wire data
+            return ValueError(f"malformed rANS stream: {what} "
+                              f"(block {b}, si={si}, vi={vi})")
+
         for b in range(n_blocks):
             if b % reset == 0:
                 pred = 0
+            if si >= n_syms:
+                raise _bad("symbol stream exhausted at DC")
             s = int(syms[si]); si += 1
             size = s - 256
+            if not 0 <= size <= 15:
+                raise _bad(f"DC symbol {s} out of range")
             if size:
+                if vi >= n_vals:
+                    raise _bad("value stream exhausted at DC")
                 raw = int(vals[vi]); vi += 1
                 diff = raw if raw >= (1 << (size - 1)) \
                     else raw - (1 << size) + 1
@@ -286,14 +326,24 @@ def decode_planes(data: bytes, y_blocks: int, c_blocks: int,
             blocks[b, 0] = pred
             k = 1
             while k < 64:
+                if si >= n_syms:
+                    raise _bad("symbol stream exhausted mid-block")
                 s = int(syms[si]); si += 1
                 if s == 0x00:
                     break
                 if s == 0xF0:
                     k += 16
                     continue
+                if not 0 <= s <= 0xFF:
+                    raise _bad(f"AC symbol {s} out of range")
                 run, size = s >> 4, s & 15
+                if size == 0:
+                    raise _bad(f"AC symbol {s:#x} has zero size")
                 k += run
+                if k >= 64:
+                    raise _bad(f"run overflows block ({k})")
+                if vi >= n_vals:
+                    raise _bad("value stream exhausted mid-block")
                 raw = int(vals[vi]); vi += 1
                 v = raw if raw >= (1 << (size - 1)) else raw - (1 << size) + 1
                 blocks[b, k] = v

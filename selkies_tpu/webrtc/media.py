@@ -53,8 +53,17 @@ class MediaTrack:
 
 
 def _split_access_units(data: bytes) -> List[bytes]:
-    """Split an Annex-B elementary stream into access units at AUD/SPS/IDR/
-    non-IDR boundaries (each AU keeps its leading parameter sets)."""
+    """Split an Annex-B elementary stream into access units.
+
+    A new AU starts at an AUD NAL (type 9) or at a VCL NAL whose
+    first_mb_in_slice == 0 — the first ue(v) of the slice header, which
+    is zero exactly when the first payload bit after the NAL header is 1.
+    Multi-slice pictures (e.g. .h264 files recorded from this framework's
+    own multi-stripe frames, one slice NAL per stripe) therefore keep all
+    their slices in one AU and replay at the real frame rate. Stripe
+    recordings replay as full-frame AUs: per-stripe geometry is not
+    representable in an elementary stream. Leading SPS/PPS/SEI attach to
+    the AU that follows them."""
     starts: List[int] = []
     i = 0
     n = len(data)
@@ -69,21 +78,36 @@ def _split_access_units(data: bytes) -> List[bytes]:
             i += 1
     if not starts:
         return [data] if data else []
-    units: List[Tuple[int, int]] = []   # (nal_type, offset)
+    units: List[Tuple[int, int, int]] = []   # (nal_type, offset, payload_off)
     for off in starts:
         j = off + (4 if data[off:off + 4] == b"\x00\x00\x00\x01" else 3)
         if j < n:
-            units.append((data[j] & 0x1F, off))
+            units.append((data[j] & 0x1F, off, j + 1))
+    if not units:
+        return [data]
+    new_au = []
+    for nal, off, poff in units:
+        first_slice = (nal in (1, 5) and poff < n
+                       and (data[poff] & 0x80) != 0)
+        new_au.append(nal == 9 or first_slice)
+    bounds: List[int] = [0]              # indices into units starting an AU
+    seen_vcl = False
+    for idx, (nal, off, poff) in enumerate(units):
+        if idx > 0 and new_au[idx] and seen_vcl:
+            # pull the contiguous non-VCL run before this NAL into the
+            # new AU — those parameter sets/SEI prefix the coming picture
+            j = idx
+            while j - 1 > bounds[-1] and units[j - 1][0] not in (1, 5):
+                j -= 1
+            bounds.append(j)
+            seen_vcl = False
+        if nal in (1, 5):
+            seen_vcl = True
     aus: List[bytes] = []
-    au_start: Optional[int] = None
-    for idx, (nal, off) in enumerate(units):
-        if nal in (1, 5):               # VCL NAL ends the AU
-            start = au_start if au_start is not None else off
-            end = units[idx + 1][1] if idx + 1 < len(units) else n
-            aus.append(data[start:end])
-            au_start = None
-        elif au_start is None:
-            au_start = off              # SPS/PPS/SEI prefix the next AU
+    for bi, ui in enumerate(bounds):
+        start = units[ui][1]
+        end = units[bounds[bi + 1]][1] if bi + 1 < len(bounds) else n
+        aus.append(data[start:end])
     return aus
 
 

@@ -22,7 +22,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .dtls import DtlsCertificate, DtlsEndpoint
-from .fec import (RED_PT, ULPFEC_PT, UlpFecDecoder, UlpFecEncoder,
+from .fec import (ULPFEC_PT, UlpFecDecoder, UlpFecEncoder,
                   red_unwrap, red_wrap)
 from .h264 import H264Depayloader, H264Payloader
 from .ice import Candidate, IceAgent
@@ -80,10 +80,17 @@ class MediaSender:
         self.sequence = (self.sequence + len(packets)) & 0xFFFF
         self._last_rtp_ts = timestamp & 0xFFFFFFFF
         self._last_send_wall = time.time()
+        # FEC rides only when the negotiated remote description includes
+        # red+ulpfec — a peer that remapped or rejected them must get
+        # plain media, not PT-103 packets it never agreed to
+        red_pt = self.pc._red_pt
+        ulpfec_pt = self.pc._ulpfec_pt
+        fec = self._fec if (red_pt is not None
+                            and ulpfec_pt is not None) else None
         for pkt in packets:
             # transport-wide sequencing feeds the sender-side GCC estimator
             pkt.extensions[TWCC_EXT_ID] = pack_twcc_seq(self.pc._next_twcc())
-            if self._fec is None:
+            if fec is None:
                 self._ship(pkt.sequence_number, pkt.serialize(),
                            len(pkt.payload))
                 continue
@@ -91,21 +98,22 @@ class MediaSender:
             # the RED-encapsulated twin (same header, RED PT, 1-byte block
             # header) — matching libwebrtc's RED/ULPFEC arrangement.
             media_raw = pkt.serialize()
-            fec_payload = self._fec.push(media_raw)
+            fec_payload = fec.push(media_raw)
             inner = pkt.payload
-            pkt.payload_type = RED_PT
+            pkt.payload_type = red_pt
             pkt.payload = red_wrap(self.payload_type, inner)
             self._ship(pkt.sequence_number, pkt.serialize(), len(inner))
             if fec_payload is not None:
-                self._send_fec(fec_payload, timestamp)
+                self._send_fec(fec_payload, timestamp, red_pt, ulpfec_pt)
 
-    def _send_fec(self, fec_payload: bytes, timestamp: int) -> None:
+    def _send_fec(self, fec_payload: bytes, timestamp: int,
+                  red_pt: int, ulpfec_pt: int) -> None:
         seq = self.sequence
         self.sequence = (self.sequence + 1) & 0xFFFF
         pkt = RtpPacket(
-            payload_type=RED_PT, sequence_number=seq,
+            payload_type=red_pt, sequence_number=seq,
             timestamp=timestamp & 0xFFFFFFFF, ssrc=self.ssrc,
-            payload=red_wrap(ULPFEC_PT, fec_payload))
+            payload=red_wrap(ulpfec_pt, fec_payload))
         pkt.extensions[TWCC_EXT_ID] = pack_twcc_seq(self.pc._next_twcc())
         self._ship(seq, pkt.serialize(), len(pkt.payload))
 
@@ -159,6 +167,8 @@ class MediaReceiver:
         self.last_ssrc = 0
         self.packets = 0
         self.fec = UlpFecDecoder()
+        #: negotiated ulpfec PT (updated from the remote description)
+        self.ulpfec_pt = ULPFEC_PT
 
     def feed(self, packet: RtpPacket) -> None:
         self.last_ssrc = packet.ssrc
@@ -168,7 +178,7 @@ class MediaReceiver:
                 self.on_frame(self.depayloader.feed(packet), packet.timestamp)
             return
         for pkt in self.jitter.add(packet):
-            if pkt.payload_type == ULPFEC_PT:
+            if pkt.payload_type == self.ulpfec_pt:
                 continue      # seq-space placeholder (see feed_red)
             frame = self.depayloader.feed(pkt)
             if frame is not None and self.on_frame is not None:
@@ -179,13 +189,13 @@ class MediaReceiver:
         the recovery cache, media blocks to the normal path, and feed any
         packets FEC can now reconstruct."""
         for pt, data in red_unwrap(packet.payload):
-            if pt == ULPFEC_PT:
+            if pt == self.ulpfec_pt:
                 self.fec.add_fec(data)
                 # FEC packets share the media sequence space (RFC 5109
                 # with RED) — run an empty placeholder through the jitter
                 # buffer so its seq doesn't head-of-line block the stream
                 self.feed(RtpPacket(
-                    payload_type=ULPFEC_PT,
+                    payload_type=self.ulpfec_pt,
                     sequence_number=packet.sequence_number,
                     timestamp=packet.timestamp, ssrc=packet.ssrc))
                 continue
@@ -233,6 +243,13 @@ class PeerConnection:
         self.on_keyframe_request: Optional[Callable[[], None]] = None
 
         self.is_offerer: Optional[bool] = None
+        # payload types as negotiated by the remote description; media PTs
+        # start at our defaults, RED/ULPFEC stay None until a remote
+        # description that includes both arrives
+        self._video_pt = VIDEO_PT
+        self._audio_pt = AUDIO_PT
+        self._red_pt: Optional[int] = None
+        self._ulpfec_pt: Optional[int] = None
         self._local_desc: Optional[SessionDescription] = None
         self._remote_desc: Optional[SessionDescription] = None
         self._pending_channels: List[Tuple[str, dict]] = []
@@ -245,21 +262,26 @@ class PeerConnection:
 
     def add_video_sender(self, ssrc: Optional[int] = None) -> MediaSender:
         ssrc = ssrc or struct.unpack("!I", os.urandom(4))[0]
-        s = MediaSender(self, "video", ssrc, VIDEO_PT, VIDEO_CLOCK)
+        s = MediaSender(self, "video", ssrc, self._video_pt, VIDEO_CLOCK)
         self.senders[ssrc] = s
         return s
 
     def add_audio_sender(self, ssrc: Optional[int] = None) -> MediaSender:
         ssrc = ssrc or struct.unpack("!I", os.urandom(4))[0]
-        s = MediaSender(self, "audio", ssrc, AUDIO_PT, 48000)
+        s = MediaSender(self, "audio", ssrc, self._audio_pt, 48000)
         self.senders[ssrc] = s
         return s
 
     def video_receiver(self) -> MediaReceiver:
-        return self.receivers.setdefault(VIDEO_PT, MediaReceiver("video"))
+        recv = self.receivers.setdefault(self._video_pt,
+                                         MediaReceiver("video"))
+        if self._ulpfec_pt is not None:
+            recv.ulpfec_pt = self._ulpfec_pt
+        return recv
 
     def audio_receiver(self) -> MediaReceiver:
-        return self.receivers.setdefault(AUDIO_PT, MediaReceiver("audio"))
+        return self.receivers.setdefault(self._audio_pt,
+                                         MediaReceiver("audio"))
 
     def create_data_channel(self, label: str, protocol: str = "",
                             ordered: bool = True,
@@ -303,6 +325,7 @@ class PeerConnection:
                 "remote description carries no DTLS fingerprint "
                 "(session- or media-level a=fingerprint required)")
         m0 = media[0]
+        self._negotiate_fec()
         if self.ice is not None:
             if m0.ice_ufrag and m0.ice_pwd:
                 self.ice.set_remote_credentials(m0.ice_ufrag, m0.ice_pwd)
@@ -311,6 +334,67 @@ class PeerConnection:
                     self.ice.add_remote_candidate(cand)
         if sdp_type == "answer" and self.is_offerer:
             self._start_transport()
+
+    def _negotiate_fec(self) -> None:
+        """Adopt the remote description's payload-type numbering.
+
+        Fixed constants broke any peer that remaps PTs: its media at the
+        remapped PT would never reach a receiver and our sends would carry
+        a PT it never agreed to. Applies to the media codecs (H264, opus)
+        and to RED/ULPFEC — the FEC pair must BOTH be present in the
+        remote video section for the RED path to engage at all."""
+        self._red_pt = self._ulpfec_pt = None
+        if self._remote_desc is None:
+            return
+
+        def _adopt(kind: str, codec_name: str, current: int) -> int:
+            section = next((m for m in self._remote_desc.media
+                            if m.kind == kind), None)
+            if section is None:
+                return current
+            matches = [c for c in section.codecs
+                       if c.name.lower() == codec_name]
+            if codec_name == "h264" and len(matches) > 1:
+                # browsers offer several H264 entries differing in
+                # packetization-mode/profile; this stack sends FU-A
+                # fragmented mode-1 constrained-baseline, so prefer the
+                # entry that actually denotes that arrangement (RFC 6184:
+                # absent packetization-mode means single-NAL mode 0)
+                def rank(c):
+                    fmtp = c.fmtp or ""
+                    mode1 = "packetization-mode=1" in fmtp
+                    baseline = "profile-level-id=42" in fmtp
+                    return (mode1, baseline)
+                matches.sort(key=rank, reverse=True)
+            pt = matches[0].payload_type if matches else None
+            if pt is None or pt == current:
+                return current
+            # re-key the receiver and re-stamp senders of this kind
+            recv = self.receivers.pop(current, None)
+            if recv is not None:
+                self.receivers[pt] = recv
+            for s in self.senders.values():
+                if s.kind == kind:
+                    s.payload_type = pt
+            return pt
+
+        self._video_pt = _adopt("video", "h264", self._video_pt)
+        self._audio_pt = _adopt("audio", "opus", self._audio_pt)
+        video = next((m for m in self._remote_desc.media
+                      if m.kind == "video"), None)
+        if video is None:
+            return
+        for c in video.codecs:
+            if c.name.lower() == "red":
+                self._red_pt = c.payload_type
+            elif c.name.lower() == "ulpfec":
+                self._ulpfec_pt = c.payload_type
+        if self._red_pt is None or self._ulpfec_pt is None:
+            self._red_pt = self._ulpfec_pt = None
+            return
+        recv = self.receivers.get(self._video_pt)
+        if recv is not None:
+            recv.ulpfec_pt = self._ulpfec_pt
 
     def add_ice_candidate(self, candidate_sdp: str) -> None:
         if self.ice is not None:
@@ -350,14 +434,33 @@ class PeerConnection:
                            if s.kind == "video"), None)
         audio_ssrc = next((s.ssrc for s in self.senders.values()
                            if s.kind == "audio"), None)
+        video_codecs = default_video_codecs()
+        audio_codecs = default_audio_codecs()
+        if self._remote_desc is not None:
+            # answering: an answer may only contain codecs the offer holds
+            # — drop red/ulpfec when the remote didn't offer them, and
+            # adopt the remote's PT numbering throughout
+            for c in video_codecs:
+                if c.name == "H264":
+                    c.payload_type = self._video_pt
+                elif c.name == "red" and self._red_pt is not None:
+                    c.payload_type = self._red_pt
+                elif c.name == "ulpfec" and self._ulpfec_pt is not None:
+                    c.payload_type = self._ulpfec_pt
+            for c in audio_codecs:
+                if c.name == "opus":
+                    c.payload_type = self._audio_pt
+            if self._red_pt is None:
+                video_codecs = [c for c in video_codecs
+                                if c.name not in ("red", "ulpfec")]
         mid = 0
         media.append(MediaSection(
-            kind="video", mid=str(mid), codecs=default_video_codecs(),
+            kind="video", mid=str(mid), codecs=video_codecs,
             ssrc=video_ssrc, cname="selkies-tpu",
             msid="selkies video0", direction="sendrecv", **common))
         mids.append(str(mid)); mid += 1
         media.append(MediaSection(
-            kind="audio", mid=str(mid), codecs=default_audio_codecs(),
+            kind="audio", mid=str(mid), codecs=audio_codecs,
             ssrc=audio_ssrc, cname="selkies-tpu",
             msid="selkies audio0", direction="sendrecv", **common))
         mids.append(str(mid)); mid += 1
@@ -466,7 +569,7 @@ class PeerConnection:
             seq = int.from_bytes(ext, "big")
             self._twcc_recv[seq] = int(time.monotonic() * 1e6)
             self._twcc_recv_ssrc = pkt.ssrc
-        if pkt.payload_type == RED_PT:
+        if self._red_pt is not None and pkt.payload_type == self._red_pt:
             self.video_receiver().feed_red(pkt)
             return
         recv = self.receivers.get(pkt.payload_type)
@@ -547,7 +650,7 @@ class PeerConnection:
     def _send_nacks(self) -> None:
         """Request retransmission of jitter-buffer gaps (video only; audio
         rides concealment)."""
-        recv = self.receivers.get(VIDEO_PT)
+        recv = self.receivers.get(self._video_pt)
         if recv is None or self.srtp_tx is None:
             return
         missing = recv.jitter.missing()

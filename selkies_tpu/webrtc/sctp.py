@@ -170,9 +170,12 @@ class SctpAssociation:
         # advertised window (updated from every SACK)
         self.cwnd = min(4 * MTU, max(2 * MTU, 4380))
         self.ssthresh = 1 << 20
+        # remaining NEW-data allowance: a_rwnd minus outstanding bytes,
+        # decremented on each send and recomputed from every SACK
         self.peer_rwnd = 1 << 20
         self.flight = 0                     # DATA chunk bytes outstanding
         self._partial_bytes_acked = 0
+        self._last_t3 = 0.0                 # last T3 cwnd-collapse time
         self._recv_tsns: set = set()
         self._next_even_odd = 0 if is_client else 1
         self._setup_chunk: Optional[Tuple[bytes, int]] = None  # (chunk, vtag)
@@ -231,11 +234,30 @@ class SctpAssociation:
             chunk, vtag = self._setup_chunk
             self._setup_sent_at = now
             self._send_packet([chunk], vtag=vtag)
-        rto_fired = False
-        for chunk in list(self._out.values()):
-            if now - chunk.sent_at > RTO * (2 ** min(chunk.retransmits, 4)):
+        # dict preserves insertion order == send order, so this list is
+        # already earliest-TSN-first within the association
+        expired = [c for c in self._out.values()
+                   if now - c.sent_at > RTO * (2 ** min(c.retransmits, 4))]
+        if expired:
+            # RFC 4960 §7.2.3: collapse cwnd to one MTU FIRST, then
+            # retransmit only the earliest chunk(s) that fit that single
+            # MTU. The rest stay marked expired; SACK arrivals and later
+            # timer fires drive them out, so one timeout cannot re-blast
+            # the whole outstanding window into a congested path. The
+            # multiplicative decrease applies once per RTO window, not on
+            # every 50 ms tick that still sees the draining backlog —
+            # otherwise ssthresh gets crushed to its 4-MTU floor and the
+            # path-capacity memory it carries is destroyed.
+            if now - self._last_t3 >= RTO:
+                self._last_t3 = now
+                self.ssthresh = max(self.cwnd // 2, 4 * MTU)
+                self.cwnd = MTU
+                self._partial_bytes_acked = 0
+            sent = 0
+            for chunk in expired:
+                if sent and sent + len(chunk.data) > MTU:
+                    break
                 chunk.retransmits += 1
-                chunk.sent_at = now
                 if chunk.retransmits > 8:
                     # RFC 4960 §8.1: endpoint failure — a reliable channel
                     # must not silently turn best-effort
@@ -247,13 +269,9 @@ class SctpAssociation:
                     self._queue.clear()
                     self.flight = 0
                     return
-                rto_fired = True
+                chunk.sent_at = now
                 self._send_packet([chunk.data])
-        if rto_fired:
-            # RFC 4960 §7.2.3: T3-rtx collapses cwnd to one MTU
-            self.ssthresh = max(self.cwnd // 2, 4 * MTU)
-            self.cwnd = MTU
-            self._partial_bytes_acked = 0
+                sent += len(chunk.data)
         self._flush(now)
 
     # ----------------------------------------------------------- receive
@@ -378,17 +396,24 @@ class SctpAssociation:
 
         One chunk is always allowed when nothing is in flight (the
         zero-window probe of RFC 4960 §6.1 A), so the association cannot
-        deadlock on a zero advertisement."""
-        window = min(self.cwnd, self.peer_rwnd)
+        deadlock on a zero advertisement.
+
+        The two windows gate differently: cwnd bounds total outstanding
+        bytes (flight + new), while peer_rwnd is already the REMAINING
+        new-data allowance (a_rwnd minus outstanding, recomputed on every
+        SACK and decremented per send) — comparing flight against it too
+        would double-count the in-flight bytes."""
         while self._queue:
             chunk = self._queue[0]
             size = len(chunk.data)
-            if self.flight > 0 and self.flight + size > window:
+            if self.flight > 0 and (self.flight + size > self.cwnd
+                                    or size > self.peer_rwnd):
                 return
             self._queue.pop(0)
             chunk.sent_at = time.monotonic() if now is None else now
             self._out[chunk.tsn] = chunk
             self.flight += size
+            self.peer_rwnd = max(0, self.peer_rwnd - size)
             self._send_packet([chunk.data])
 
     def _on_data(self, flags: int, body: bytes) -> None:
@@ -588,7 +613,6 @@ class SctpAssociation:
         if len(body) < 12:
             return
         cum, rwnd, n_gaps, n_dups = struct.unpack_from("!IIHH", body)
-        self.peer_rwnd = rwnd
         acked_bytes = 0
 
         def _ack(tsn: int) -> None:
@@ -642,6 +666,10 @@ class SctpAssociation:
             self.ssthresh = max(self.cwnd // 2, 4 * MTU)
             self.cwnd = self.ssthresh
             self._partial_bytes_acked = 0
+        # RFC 4960 §6.2.1: the usable window is the advertised a_rwnd less
+        # bytes still in flight that this SACK did not cover, so _flush
+        # cannot overrun the receiver's buffer by a full flight
+        self.peer_rwnd = max(0, rwnd - self.flight)
         self._flush()
 
     # ------------------------------------------------------------- DCEP

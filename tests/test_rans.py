@@ -107,3 +107,30 @@ def test_decision_memo_exists():
         os.path.abspath(__file__))), "docs", "config3_decision.md")
     text = open(memo).read()
     assert "Decision" in text and "rANS" in text
+
+
+def test_decode_planes_truncated_raises_cleanly():
+    """Corrupt/truncated input must raise ValueError, not IndexError
+    (ADVICE r2: rans.py decode_planes bounds)."""
+    y, cb, cr = sparse_planes(seed=9)
+    blob = rans.encode_planes(y, cb, cr, blocks_per_stripe_y=16)
+    # claim more blocks than the stream encodes → symbol exhaustion
+    with pytest.raises(ValueError, match="malformed"):
+        rans.decode_planes(blob, len(y) * 2, len(cb) + len(cr), 16)
+
+
+def test_decode_planes_corrupt_symbols_raise_cleanly():
+    y, cb, cr = sparse_planes(seed=10)
+    blob = bytearray(rans.encode_planes(y, cb, cr, blocks_per_stripe_y=16))
+    rng = np.random.default_rng(11)
+    for _ in range(40):
+        trial = bytearray(blob)
+        i = int(rng.integers(12, len(trial)))
+        trial[i] ^= 0xFF
+        try:
+            rans.decode_planes(bytes(trial), len(y), len(cb) + len(cr), 16)
+        except ValueError:
+            pass              # clean decode error is the contract
+        except Exception as exc:   # IndexError/struct.error are NOT
+            raise AssertionError(
+                f"corrupt byte {i} raised {type(exc).__name__}: {exc}")

@@ -118,6 +118,10 @@ def test_sender_emits_red_and_fec(monkeypatch):
         def __init__(self):
             self.sent = []
             self._twcc = 0
+            # RED/ULPFEC only ride once the remote description negotiated
+            # them; the fake peer agreed to the default PTs
+            self._red_pt = RED_PT
+            self._ulpfec_pt = ULPFEC_PT
 
         def _next_twcc(self):
             self._twcc = (self._twcc + 1) & 0xFFFF

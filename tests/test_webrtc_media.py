@@ -29,13 +29,19 @@ def write_wav(path, seconds=0.2, rate=48000, channels=2, freq=440.0):
     return pcm
 
 
-def make_annexb(n_aus=5):
+def make_annexb(n_aus=5, slices_per_au=1):
     sps = b"\x00\x00\x00\x01\x67\x42\x00\x1f"
     pps = b"\x00\x00\x00\x01\x68\xce\x06\xe2"
     aus = []
     for i in range(n_aus):
-        nal = bytes([0x65 if i == 0 else 0x41]) + bytes([i]) * 50
-        au = (sps + pps if i == 0 else b"") + b"\x00\x00\x00\x01" + nal
+        au = sps + pps if i == 0 else b""
+        for s in range(slices_per_au):
+            # slice-header first byte: MSB set ⇔ first_mb_in_slice == 0
+            # (ue(v) == 0), which is how real first slices look; later
+            # slices of the same picture have it clear
+            hdr = (0x80 | i) if s == 0 else (i & 0x7F)
+            nal = bytes([0x65 if i == 0 else 0x41, hdr]) + bytes([i]) * 49
+            au += b"\x00\x00\x00\x01" + nal
         aus.append(au)
     return b"".join(aus), aus
 
@@ -45,6 +51,27 @@ def test_split_access_units_roundtrip():
     got = _split_access_units(stream)
     assert got == aus
     assert b"".join(got) == stream
+
+
+def test_split_access_units_multislice():
+    """Multi-slice pictures (one slice NAL per stripe, as this framework's
+    own recordings produce) must group into ONE access unit per frame, not
+    one per slice (ADVICE r2: media.py:80)."""
+    stream, aus = make_annexb(4, slices_per_au=3)
+    got = _split_access_units(stream)
+    assert got == aus
+    assert b"".join(got) == stream
+
+
+def test_split_access_units_aud_boundary():
+    """An access-unit delimiter NAL opens a new AU even when the next
+    slice's first_mb_in_slice bits are unreadable."""
+    aud = b"\x00\x00\x00\x01\x09\xf0"
+    slice0 = b"\x00\x00\x00\x01\x65\x88" + b"A" * 20
+    slice1 = b"\x00\x00\x00\x01\x41\x00" + b"B" * 20   # MSB clear
+    stream = aud + slice0 + aud + slice1
+    got = _split_access_units(stream)
+    assert got == [aud + slice0, aud + slice1]
 
 
 def test_split_access_units_empty_and_garbage():

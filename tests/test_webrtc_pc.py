@@ -194,3 +194,106 @@ def test_twcc_eviction_keeps_newest_across_wrap():
     # not the numerically largest (which right after the wrap would evict
     # the newest, stalling the GCC estimator)
     assert list(pc._twcc_sent) == seqs[-pcmod.TWCC_HISTORY:]
+
+
+def test_red_pts_follow_remote_description():
+    """ADVICE r2 (peerconnection.py:469): RED/ULPFEC payload types come
+    from the negotiated remote description — a peer that remaps them gets
+    the remapped numbers, a peer that rejects them gets no RED at all."""
+    from selkies_tpu.webrtc.sdp import SessionDescription
+
+    def sdp_with_codecs(codec_lines):
+        return "\r\n".join([
+            "v=0", "o=- 1 1 IN IP4 0.0.0.0", "s=-", "t=0 0",
+            "a=fingerprint:sha-256 " + ":".join(["AB"] * 32),
+            "m=video 9 UDP/TLS/RTP/SAVPF 102 110 111",
+            "c=IN IP4 0.0.0.0", "a=mid:0",
+            "a=rtpmap:102 H264/90000",
+        ] + codec_lines + [""])
+
+    pc = PeerConnection()
+    # remapped red/ulpfec → adopt the remote's numbers
+    pc._remote_desc = SessionDescription.parse(sdp_with_codecs(
+        ["a=rtpmap:110 red/90000", "a=rtpmap:111 ulpfec/90000"]))
+    pc._negotiate_fec()
+    assert (pc._red_pt, pc._ulpfec_pt) == (110, 111)
+    assert pc.video_receiver().ulpfec_pt == 111
+
+    # rejected red → the RED send/receive path disengages entirely
+    pc2 = PeerConnection()
+    pc2._remote_desc = SessionDescription.parse(sdp_with_codecs([]))
+    pc2._negotiate_fec()
+    assert pc2._red_pt is None and pc2._ulpfec_pt is None
+
+    # red without ulpfec is not a usable FEC arrangement
+    pc3 = PeerConnection()
+    pc3._remote_desc = SessionDescription.parse(sdp_with_codecs(
+        ["a=rtpmap:110 red/90000"]))
+    pc3._negotiate_fec()
+    assert pc3._red_pt is None and pc3._ulpfec_pt is None
+
+
+def test_media_pts_follow_remote_description():
+    """Remapped H264/opus payload types in the remote description re-key
+    receivers and re-stamp senders — fixed media PTs break the same way
+    fixed FEC PTs did."""
+    from selkies_tpu.webrtc.sdp import SessionDescription
+
+    sdp = "\r\n".join([
+        "v=0", "o=- 1 1 IN IP4 0.0.0.0", "s=-", "t=0 0",
+        "a=fingerprint:sha-256 " + ":".join(["AB"] * 32),
+        "m=video 9 UDP/TLS/RTP/SAVPF 96",
+        "c=IN IP4 0.0.0.0", "a=mid:0",
+        "a=rtpmap:96 H264/90000",
+        "m=audio 9 UDP/TLS/RTP/SAVPF 97",
+        "c=IN IP4 0.0.0.0", "a=mid:1",
+        "a=rtpmap:97 opus/48000/2", ""])
+
+    pc = PeerConnection()
+    vs = pc.add_video_sender(ssrc=0x10)
+    recv = pc.video_receiver()
+    pc._remote_desc = SessionDescription.parse(sdp)
+    pc._negotiate_fec()
+    assert pc._video_pt == 96 and pc._audio_pt == 97
+    assert vs.payload_type == 96                 # sender re-stamped
+    assert pc.receivers.get(96) is recv          # receiver re-keyed
+    assert pc.video_receiver() is recv
+    assert pc.audio_receiver() is pc.receivers[97]
+
+
+def test_decode_planes_huge_nsym_rejected():
+    """A tiny blob claiming a giant symbol count must fail fast, not
+    allocate gigabytes (code-review r3 finding)."""
+    import struct as _s
+
+    import numpy as np
+    import pytest as _pytest
+
+    from selkies_tpu.encoder import rans
+    y = np.zeros((8, 64), np.int16)
+    c = np.zeros((2, 64), np.int16)
+    blob = bytearray(rans.encode_planes(y, c, c, 8))
+    _s.pack_into("<I", blob, 0, 0x0FFFFFFF)      # nsym → absurd
+    with _pytest.raises(ValueError, match="malformed"):
+        rans.decode_planes(bytes(blob), 8, 4, 8)
+
+
+def test_h264_pt_adoption_prefers_mode1_baseline():
+    """Among several remote H264 entries, adopt the packetization-mode=1
+    constrained-baseline one — this stack sends FU-A mode-1 streams."""
+    from selkies_tpu.webrtc.sdp import SessionDescription
+
+    sdp = "\r\n".join([
+        "v=0", "o=- 1 1 IN IP4 0.0.0.0", "s=-", "t=0 0",
+        "a=fingerprint:sha-256 " + ":".join(["AB"] * 32),
+        "m=video 9 UDP/TLS/RTP/SAVPF 98 99",
+        "c=IN IP4 0.0.0.0", "a=mid:0",
+        "a=rtpmap:98 H264/90000",
+        "a=fmtp:98 packetization-mode=0;profile-level-id=42e01f",
+        "a=rtpmap:99 H264/90000",
+        "a=fmtp:99 level-asymmetry-allowed=1;packetization-mode=1;"
+        "profile-level-id=42e01f", ""])
+    pc = PeerConnection()
+    pc._remote_desc = SessionDescription.parse(sdp)
+    pc._negotiate_fec()
+    assert pc._video_pt == 99

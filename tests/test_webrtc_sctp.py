@@ -406,3 +406,53 @@ def test_flight_accounting_on_ack():
     assert a.flight > 0
     pump(a, b, qa, qb)
     assert a.flight == 0 and not a._out
+
+
+def test_rto_retransmits_at_most_one_mtu():
+    """RFC 4960 §7.2.3 (ADVICE r2): a T3 timeout must collapse cwnd FIRST
+    and then retransmit only the earliest chunk(s) fitting one MTU — not
+    re-blast the entire expired flight into the congested path."""
+    a, b, qa, qb, ch = _established_pair()
+    a.cwnd = 200_000
+    blob = bytes(range(256)) * 400           # ~100 KB, many fragments
+    a.send(ch, blob)
+    n_out = len(a._out)
+    assert n_out > 10
+    qa.clear()                               # the whole flight is lost
+    a.check_retransmit(now=1e9)
+    # only what fits one MTU went back out (plus whatever _flush then
+    # admits from the queue under the collapsed 1-MTU window: nothing,
+    # because the flight is still outstanding)
+    rtx_bytes = sum(len(p) for p in qa)
+    assert rtx_bytes <= 2 * MTU_BYTES        # 1 MTU of DATA + headers
+    assert a.cwnd == MTU_BYTES
+    # untouched chunks keep their send stamp and drain on later fires
+    assert sum(1 for c in a._out.values() if c.retransmits) <= 2
+    # the association still completes once the path heals
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+    for _ in range(n_out + 50):
+        a.check_retransmit(now=2e9)
+        while qa:
+            b.receive(qa.pop(0))
+        while qb:
+            a.receive(qb.pop(0))
+        if got:
+            break
+    assert got == [blob]
+
+
+def test_sack_rwnd_discounts_flight():
+    """RFC 4960 §6.2.1 (ADVICE r2): the usable peer window is a_rwnd minus
+    bytes still in flight that the SACK did not cover."""
+    a, b, qa, qb, ch = _established_pair()
+    a.cwnd = 200_000
+    a.send(ch, b"z" * 40_000)
+    sent_first = qa.pop(0)
+    qa.clear()                               # rest of the flight in the air
+    in_flight_before = a.flight
+    b.receive(sent_first)
+    sack = qb.pop(0)
+    a.receive(sack)                          # SACK covers only chunk 1
+    assert a.flight < in_flight_before
+    assert a.peer_rwnd <= max(0, b.a_rwnd - a.flight)
