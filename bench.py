@@ -100,24 +100,26 @@ def bench_h264() -> dict:
     from selkies_tpu.encoder.h264 import H264StripeEncoder
     from selkies_tpu.encoder.pipeline import PipelinedH264Encoder
 
+    BATCH = 12
     enc = H264StripeEncoder(W, H)
-    pipe = PipelinedH264Encoder(enc, depth=12, fetch_group=6)
-    src = DeviceScrollSource(W, H)
+    # the P-frame reference chain rides a lax.scan inside ONE device
+    # program per batch (dev.encode_frame_p_batch_rgb) and the source
+    # emits the whole batch in one program, so the tunnel's fixed
+    # per-dispatch RPC cost is paid ~2x per 12 frames instead of ~4x
+    # per frame (round 2: 12 fps; with batching: ~46 fps same chip)
+    pipe = PipelinedH264Encoder(enc, depth=3 * BATCH, batch=BATCH)
+    src = DeviceScrollSource(W, enc.pad_h)
 
-    def nxt():
-        f = src.next_frame()
-        if f.shape[0] != enc.pad_h:
-            f = jnp.concatenate([f, f[:enc.pad_h - f.shape[0]]], axis=0)
-        return f
-
-    for _ in range(6):
-        enc.encode_frame(nxt())
+    for _ in range(2):
+        enc.encode_frame(src.next_frame())  # IDR + single-frame compile
+    for _ in range(2):                      # batch-program compile
+        pipe.submit_batch(src.next_batch(BATCH))
+    for _ in pipe.flush():
+        pass
     done, nb = 0, 0
     start = time.perf_counter()
-    while done < 150 and time.perf_counter() - start < MAX_SECONDS / 3:
-        pipe.submit(nxt())
-        # throughput mode: only full fetch groups ship, so each ~100 ms
-        # RPC read carries fetch_group frames' sparse buffers
+    while done < 300 and time.perf_counter() - start < MAX_SECONDS / 3:
+        pipe.submit_batch(src.next_batch(BATCH))
         for _seq, out in pipe.poll(flush_partial=False):
             done += 1
             nb += sum(len(s.annexb) for s in out)
@@ -128,11 +130,12 @@ def bench_h264() -> dict:
     fps = done / elapsed if elapsed > 0 else 0.0
     return {
         "h264_1080p_fps": round(fps, 2),
+        "h264_batch": BATCH,
         "h264_mean_frame_kb": round(nb / max(done, 1) / 1024, 1),
-        # ~90 KB of sparse-packed levels per 1080p frame cross D2H for
-        # host CAVLC, several frames per read; the tunnel's fixed ~100 ms
-        # per-read RPC latency is the remaining ceiling (sub-ms PCIe).
-        "h264_bottleneck": "per-read RPC latency over tunneled transport",
+        # remaining ceiling: the per-batch heads read (~1.2 MB over a
+        # 5-25 MB/s tunnel) + the serialized batch execution; both are
+        # sub-millisecond-class on PCIe hosts
+        "h264_bottleneck": "per-batch D2H read over tunneled transport",
     }
 
 

@@ -34,12 +34,26 @@ class DeviceScrollSource:
         self.width, self.height = width, height
         self._bg = jax.device_put(base._bg)
         self._roll = jax.jit(lambda bg, t: jnp.roll(bg, shift=-4 * t, axis=0))
+
+        def roll_batch(bg, t0, n):
+            ts = t0 + jnp.arange(n)
+            return jax.vmap(lambda t: jnp.roll(bg, shift=-4 * t, axis=0))(ts)
+
+        self._roll_batch = jax.jit(roll_batch, static_argnames=("n",))
         self._t = 0
 
     def next_frame(self):
         t = self._t
         self._t += 1
         return self._roll(self._bg, t % self.height)
+
+    def next_batch(self, n: int):
+        """(n, H, W, 3) scrolled frames in ONE device program — a
+        per-frame roll would cost n dispatches, which on RPC-attached
+        transports costs more than the encode itself."""
+        t = self._t
+        self._t += n
+        return self._roll_batch(self._bg, t % self.height, n)
 
 
 class SyntheticSource(FrameSource):

@@ -33,6 +33,21 @@ logger = logging.getLogger("selkies_tpu.encoder.h264")
 
 MB = 16
 
+_POOL = None
+
+
+def _entropy_pool():
+    """Shared thread pool for per-stripe CAVLC (the C coder releases the
+    GIL, so stripes of one frame entropy-code concurrently)."""
+    global _POOL
+    if _POOL is None:
+        import concurrent.futures
+        import os
+        _POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, os.cpu_count() or 4),
+            thread_name_prefix="cavlc")
+    return _POOL
+
 
 # ---------------------------------------------------------------------------
 # SPS / PPS
@@ -286,6 +301,10 @@ class H264StripeEncoder:
         self._buf_bytes = self._fixed_bytes \
             + self.n_stripes * self._cap_cells * dev.CELL
         self._sparse_guess = self._bucket(self._fixed_bytes + (64 << 10))
+        #: batch dispatches need a STABLE static prefix — an adaptive one
+        #: recompiles the (expensive) batched program on every bucket
+        #: move. Undershoot falls back to the exact flat16 rows.
+        self._batch_prefix = self._bucket(self._fixed_bytes + (96 << 10))
 
     def _bucket(self, nbytes: int) -> int:
         """Power-of-two fetch prefix (bounds distinct slice executables)."""
@@ -315,7 +334,6 @@ class H264StripeEncoder:
         ``fetch=False`` skips starting the host copy; the caller owns the
         transfer (PipelinedH264Encoder groups several frames per read)."""
         rgb = jnp.asarray(rgb)
-        y, cb, cr = dev.prepare_planes(rgb, self.pad_h, self.pad_w)
 
         is_idr = any(st.need_idr for st in self.stripes)
         if is_idr:
@@ -334,36 +352,87 @@ class H264StripeEncoder:
                     paint[i] = 1
                     st.painted_over = True
 
+        head = None
         if is_idr:
             (flat8, flat16, self._prev_y, self._prev_cb, self._prev_cr,
-             self._ref_y, self._ref_cb, self._ref_cr) = dev.encode_frame_idr(
-                y, cb, cr, self._prev_y, self._prev_cb, self._prev_cr,
-                self._ref_y, self._ref_cb, self._ref_cr,
-                jnp.int32(self.qp),
-                n_stripes=self.n_stripes, sh=self.stripe_h)
-        else:
-            (buf, flat16, self._prev_y, self._prev_cb, self._prev_cr,
              self._ref_y, self._ref_cb, self._ref_cr) = \
-                dev.encode_frame_p_sparse(
-                    y, cb, cr, self._prev_y, self._prev_cb, self._prev_cr,
+                dev.encode_frame_idr_rgb(
+                    rgb, self._prev_y, self._prev_cb, self._prev_cr,
+                    self._ref_y, self._ref_cb, self._ref_cr,
+                    jnp.int32(self.qp), pad_h=self.pad_h, pad_w=self.pad_w,
+                    n_stripes=self.n_stripes, sh=self.stripe_h)
+            pending_buf = None
+            fetch_arr = flat16 if fetch else None
+        else:
+            # the whole per-frame program — planes, encode, pack, and the
+            # fetch-prefix slice — is ONE dispatch (RPC-attached devices
+            # pay per program, not per FLOP)
+            (buf, head, flat16, self._prev_y, self._prev_cb, self._prev_cr,
+             self._ref_y, self._ref_cb, self._ref_cr) = \
+                dev.encode_frame_p_rgb(
+                    rgb, self._prev_y, self._prev_cb, self._prev_cr,
                     self._ref_y, self._ref_cb, self._ref_cr,
                     jnp.asarray(paint, jnp.int32),
                     jnp.int32(self.qp), jnp.int32(self.paint_over_qp),
+                    pad_h=self.pad_h, pad_w=self.pad_w,
                     n_stripes=self.n_stripes, sh=self.stripe_h,
-                    search=self.search)
+                    # pinned prefix: an adaptive one is a *static* arg,
+                    # so every bucket move would recompile this whole
+                    # program mid-stream; undershoot re-reads from buf
+                    search=self.search, prefix=self._batch_prefix)
             pending_buf = buf
-        if is_idr:
-            pending_buf = None
-            fetch_arr = flat16 if fetch else None
-        elif fetch:
-            fetch_arr = buf[:self._sparse_guess]
-        else:
-            fetch_arr = None
+            fetch_arr = head if fetch else None
         if fetch_arr is not None:
             fetch_arr.copy_to_host_async()
         qp_arr = np.where(paint != 0, self.paint_over_qp, self.qp)
         return _H264Pending(fetch=fetch_arr, flat16=flat16, is_idr=is_idr,
-                            paint=paint, qp=qp_arr, buf=pending_buf)
+                            paint=paint, qp=qp_arr, buf=pending_buf,
+                            head=head, head_len=self._batch_prefix)
+
+    def dispatch_batch(self, rgbs, fetch: bool = True
+                       ) -> List["_H264Pending"]:
+        """Encode B sequential frames in ONE device dispatch.
+
+        ``rgbs``: (B, H, W, 3) uint8 (device or host). The P-frame
+        reference chain rides a scan inside the program
+        (dev.encode_frame_p_batch_rgb), so RPC-attached transports pay
+        one round trip per batch instead of per frame. Falls back to
+        per-frame dispatch while any stripe needs an IDR."""
+        B = int(rgbs.shape[0])
+        if any(st.need_idr for st in self.stripes):
+            # keyframe recovery must not wait on a compile: the single
+            # frame programs are already built, whereas a (B-1)-shaped
+            # batch scan would compile from scratch mid-recovery
+            return [self.dispatch(rgbs[b], fetch=fetch) for b in range(B)]
+        paints = np.zeros((B, self.n_stripes), np.int8)
+        for b in range(B):
+            for i, st in enumerate(self.stripes):
+                if (st.static_frames >= self.paint_over_trigger
+                        and not st.painted_over):
+                    paints[b, i] = 1
+                    st.painted_over = True
+        qps = np.where(paints != 0, self.paint_over_qp, self.qp)
+        prefix = self._batch_prefix
+        (heads, flat16s, self._prev_y, self._prev_cb, self._prev_cr,
+         self._ref_y, self._ref_cb, self._ref_cr) = \
+            dev.encode_frame_p_batch_rgb(
+                jnp.asarray(rgbs),
+                self._prev_y, self._prev_cb, self._prev_cr,
+                self._ref_y, self._ref_cb, self._ref_cr,
+                jnp.asarray(paints, jnp.int32),
+                jnp.full((B,), self.qp, jnp.int32),
+                jnp.int32(self.paint_over_qp),
+                pad_h=self.pad_h, pad_w=self.pad_w,
+                n_stripes=self.n_stripes, sh=self.stripe_h,
+                search=self.search, prefix=prefix)
+        if fetch:
+            heads.copy_to_host_async()
+        cache: Dict[str, np.ndarray] = {}   # shared host copy of heads
+        return [_H264Pending(
+            fetch=None, flat16=None, is_idr=False, paint=paints[b],
+            qp=qps[b], batch_heads=heads, batch_flat16=flat16s,
+            batch_index=b, head_len=prefix,
+            batch_cache=cache) for b in range(B)]
 
     def harvest(self, p: "_H264Pending",
                 host: Optional[np.ndarray] = None) -> List[H264Stripe]:
@@ -371,7 +440,13 @@ class H264StripeEncoder:
         levels). Must be called in dispatch order. ``host`` supplies the
         already-fetched bytes when a pipeline owns the transfer."""
         if host is None:
-            host = np.asarray(p.fetch)
+            if p.batch_heads is not None:
+                # one device read shared by every frame of the batch
+                if p.batch_cache.get("heads") is None:
+                    p.batch_cache["heads"] = np.asarray(p.batch_heads)
+                host = p.batch_cache["heads"][p.batch_index]
+            else:
+                host = np.asarray(p.fetch)
         if p.is_idr:
             levels16 = host
             damage = np.ones(self.n_stripes, bool)
@@ -387,11 +462,23 @@ class H264StripeEncoder:
             used = np.minimum(counts, self._cap_cells) * dev.CELL
             needed = self._fixed_bytes + int(used.sum())
             if needed > len(host):
-                # guessed prefix undershot: one more fetch of the right
-                # bucket (and remember the level for the next frame)
-                full = p.buf[:self._bucket(needed)]
-                full.copy_to_host_async()
-                host = np.asarray(full)
+                if p.buf is not None:
+                    # guessed prefix undershot: one more fetch of the
+                    # right bucket (and remember the level next frame)
+                    full = p.buf[:self._bucket(needed)]
+                    full.copy_to_host_async()
+                    host = np.asarray(full)
+                else:
+                    # batch dispatch keeps no full sparse buffer; the
+                    # exact flat16 rows recover every emitting stripe,
+                    # and the pinned batch prefix grows (bucketed, so
+                    # recompiles are bounded) so high-entropy content
+                    # doesn't pay this cliff on every future batch
+                    ovf = ovf | damage | (p.paint != 0)
+                    self._batch_prefix = min(
+                        self._buf_bytes,
+                        max(self._batch_prefix,
+                            self._bucket(needed + needed // 2)))
             self._sparse_guess = self._bucket(
                 max(needed + needed // 2, self._fixed_bytes + 4096))
             bitmaps = host[4 * S:self._fixed_bytes] \
@@ -400,9 +487,18 @@ class H264StripeEncoder:
                 [[0], np.cumsum(used)[:-1]]) + self._fixed_bytes
             # exact re-reads for clipped stripes, all started before any
             # blocks (rare: |level| > 127 at streaming QPs)
+            if p.flat16 is None and p.batch_flat16 is not None:
+                p.flat16 = p.batch_flat16[p.batch_index]
             refetch = {}
-            for i in range(self.n_stripes):
-                if ovf[i] and (damage[i] or p.paint[i]):
+            need_rows = [i for i in range(self.n_stripes)
+                         if ovf[i] and (damage[i] or p.paint[i])]
+            if len(need_rows) > 2:
+                # whole-frame fallback (batch undershoot): ONE read of
+                # the exact levels instead of a per-stripe RPC each
+                rows_host = np.asarray(p.flat16)
+                refetch = {i: rows_host[i] for i in need_rows}
+            else:
+                for i in need_rows:
                     sl = p.flat16[i]
                     sl.copy_to_host_async()
                     refetch[i] = sl
@@ -410,6 +506,7 @@ class H264StripeEncoder:
         out: List[H264Stripe] = []
         mb_w = self.pad_w // MB
         mb_h = self.stripe_h // MB
+        jobs: List[tuple] = []
         for i, st in enumerate(self.stripes):
             if p.is_idr:
                 emit, is_key = True, True
@@ -447,25 +544,43 @@ class H264StripeEncoder:
                 parts.append(row[pos:pos + size].reshape(shape))
                 pos += size
             mv, luma, luma_dc, chroma_dc, chroma_ac = parts
-            qp = int(p.qp[i])
+            jobs.append((i, st, is_key, int(p.qp[i]),
+                         (mv, luma, luma_dc, chroma_dc, chroma_ac)))
+
+        def run_one(job):
+            i, st, is_key, qp, arrays = job
+            mv, luma, luma_dc, chroma_dc, chroma_ac = arrays
+            if is_key:
+                nals = encode_picture_nals_np(
+                    mv, luma, luma_dc, chroma_dc, chroma_ac,
+                    is_idr=True, mb_w=mb_w, mb_h=mb_h, qp=qp,
+                    frame_num=0, idr_pic_id=st.idr_pic_id)
+                return self._sps_pps_for(st) + nals
+            return encode_picture_nals_np(
+                mv, luma, luma_dc, chroma_dc, chroma_ac,
+                is_idr=False, mb_w=mb_w, mb_h=mb_h, qp=qp,
+                frame_num=st.frame_num)
+
+        def safe_one(job):
             try:
-                if is_key:
-                    nals = encode_picture_nals_np(
-                        mv, luma, luma_dc, chroma_dc, chroma_ac,
-                        is_idr=True, mb_w=mb_w, mb_h=mb_h, qp=qp,
-                        frame_num=0, idr_pic_id=st.idr_pic_id)
-                    payload = self._sps_pps_for(st) + nals
-                else:
-                    payload = encode_picture_nals_np(
-                        mv, luma, luma_dc, chroma_dc, chroma_ac,
-                        is_idr=False, mb_w=mb_w, mb_h=mb_h, qp=qp,
-                        frame_num=st.frame_num)
-            except Exception:
+                return run_one(job)
+            except Exception as exc:       # surfaced per stripe below
+                return exc
+
+        # the C coder releases the GIL: stripes entropy-code in parallel
+        # (pixelflux does the same with per-stripe C++ threads)
+        if len(jobs) > 1:
+            payloads = list(_entropy_pool().map(safe_one, jobs))
+        else:
+            payloads = [safe_one(job) for job in jobs]
+        for job, payload in zip(jobs, payloads):
+            i, st, is_key, qp, _ = job
+            if isinstance(payload, Exception):
                 # the device ref already advanced to a reconstruction the
                 # decoder will never see — resynchronize with an IDR
                 # instead of drifting every following P frame
-                logger.exception("entropy coding failed for stripe %d; "
-                                 "forcing IDR resync", i)
+                logger.error("entropy coding failed for stripe %d; "
+                             "forcing IDR resync", i, exc_info=payload)
                 st.need_idr = True
                 continue
             if is_key:
@@ -507,5 +622,11 @@ class _H264Pending:
     paint: np.ndarray
     qp: np.ndarray
     buf: object = None          # full sparse device buffer (undershoot)
+    head: object = None         # prefix slice produced inside the program
+    head_len: int = 0
+    batch_heads: object = None      # (B, prefix) heads of a batch dispatch
+    batch_flat16: object = None     # (B, S, words) exact levels
+    batch_index: int = 0
+    batch_cache: Optional[Dict] = None  # shared host copy across the batch
 
 

@@ -35,10 +35,18 @@ import numpy as np
 
 from ..ops import h264_transform as ht
 from ..ops.color import rgb_to_ycbcr, subsample_420
-from ..ops.motion import full_search_mc, full_search_mv, mc_chroma, mc_luma
+from ..ops.motion import (full_search_mc, full_search_mc_scan,
+                          full_search_mv, mc_chroma, mc_luma)
+from ..ops.pallas_me import me_mc_stripes
 
 MB = 16
 SEARCH = 12
+
+
+def _me_backend() -> str:
+    """'pallas' (default: VMEM-resident kernel) or 'xla' (chunked scan)."""
+    import os
+    return os.environ.get("SELKIES_TPU_ME", "pallas")
 
 
 class StripeEncodeOut(NamedTuple):
@@ -172,12 +180,20 @@ def encode_stripe_idr(y, cb, cr, qp) -> StripeEncodeOut:
 def encode_stripe_p(y, cb, cr, ref_y, ref_cb, ref_cr, qp,
                     search: int = SEARCH) -> StripeEncodeOut:
     """P stripe: P_16x16 with device full-search integer-pel ME."""
-    qpc = ht.qpc_for(qp)
-    h, w = y.shape
-
-    # fused ME + MC: one scan, no per-block gathers (see full_search_mc)
     mv_grid, pred_y, pred_cb, pred_cr = full_search_mc(
         y, ref_y, ref_cb, ref_cr, mb=MB, search=search)
+    return encode_stripe_p_pred(y, cb, cr, mv_grid, pred_y, pred_cb,
+                                pred_cr, qp)
+
+
+@jax.jit
+def encode_stripe_p_pred(y, cb, cr, mv_grid, pred_y, pred_cb, pred_cr,
+                         qp) -> StripeEncodeOut:
+    """P stripe transform/quant/recon given precomputed ME predictions
+    (the production path runs ME for all stripes in one Pallas kernel —
+    ops/pallas_me.py — and feeds the winners here)."""
+    qpc = ht.qpc_for(qp)
+    h, w = y.shape
 
     res_y = _mb_blocks(y.astype(jnp.int32) - pred_y.astype(jnp.int32))
     z_l, r = _encode_luma_residual(res_y, qp, intra=False)
@@ -243,9 +259,23 @@ def _frame_p_core(y, cb, cr, prev_y, prev_cb, prev_cr,
     update = damage | (paint != 0)
     qps = jnp.where(paint != 0, paint_qp, qp)            # [S]
 
-    enc = jax.vmap(
-        functools.partial(encode_stripe_p, search=search)
-    )(ys, cbs, crs, rys, rcbs, rcrs, qps)
+    # ME for every stripe in ONE VMEM-resident kernel (ops/pallas_me.py),
+    # then the per-stripe transform/quant/recon rides a vmap. The XLA
+    # chunked search remains selectable (SELKIES_TPU_ME=xla): over the
+    # tunneled dev transport, per-dispatch RPC overhead — not device
+    # compute — decides end-to-end fps, and the two backends trade
+    # differently there.
+    backend = _me_backend()
+    if backend == "pallas":
+        mv, pred_y, pred_cb, pred_cr = me_mc_stripes(
+            ys, rys, rcbs, rcrs, search=search)
+    else:
+        fn = full_search_mc_scan if backend == "scan" else full_search_mc
+        mv, pred_y, pred_cb, pred_cr = jax.vmap(
+            functools.partial(fn, mb=MB, search=search)
+        )(ys, rys, rcbs, rcrs)
+    enc = jax.vmap(encode_stripe_p_pred)(
+        ys, cbs, crs, mv, pred_y, pred_cb, pred_cr, qps)
 
     sel = update[:, None, None]
     new_ref_y = jnp.where(sel, enc.recon_y, rys).reshape(y.shape)
@@ -363,6 +393,96 @@ def encode_frame_p_sparse(y, cb, cr, prev_y, prev_cb, prev_cr,
     flat16, _ = _pack_levels(enc, damage, update)
     buf = _pack_sparse(flat16, damage, update, cap_frac=cap_frac)
     return buf, flat16, y, cb, cr, new_ref_y, new_ref_cb, new_ref_cr
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("pad_h", "pad_w", "n_stripes", "sh",
+                                    "search", "cap_frac", "prefix"),
+                   donate_argnames=("prev_y", "prev_cb", "prev_cr",
+                                    "ref_y", "ref_cb", "ref_cr"))
+def encode_frame_p_rgb(rgb, prev_y, prev_cb, prev_cr,
+                       ref_y, ref_cb, ref_cr, paint, qp, paint_qp,
+                       *, pad_h: int, pad_w: int, n_stripes: int, sh: int,
+                       search: int = SEARCH, cap_frac: int = 4,
+                       prefix: int = 0):
+    """Whole per-frame P program in ONE dispatch: RGB→planes, damage,
+    ME/MC, transform/quant/recon, sparse pack, and the fetch-prefix slice.
+
+    On RPC-attached transports each *program dispatch* pays a fixed
+    round-trip, so the eager prepare_planes ops + separate prefix slice
+    that used to surround :func:`encode_frame_p_sparse` cost more wall
+    time than the encode itself. ``prefix`` > 0 additionally returns
+    ``buf[:prefix]`` so the pipeline's fetch needs no separate slice
+    program."""
+    y, cb, cr = prepare_planes(rgb, pad_h, pad_w)
+    enc, damage, update, new_ref_y, new_ref_cb, new_ref_cr = _frame_p_core(
+        y, cb, cr, prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr,
+        paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search)
+    flat16, _ = _pack_levels(enc, damage, update)
+    buf = _pack_sparse(flat16, damage, update, cap_frac=cap_frac)
+    head = buf[:prefix] if prefix else buf
+    return (buf, head, flat16, y, cb, cr,
+            new_ref_y, new_ref_cb, new_ref_cr)
+
+
+@functools.partial(jax.jit, static_argnames=("pad_h", "pad_w",
+                                             "n_stripes", "sh"),
+                   donate_argnames=("prev_y", "prev_cb", "prev_cr",
+                                    "ref_y", "ref_cb", "ref_cr"))
+def encode_frame_idr_rgb(rgb, prev_y, prev_cb, prev_cr,
+                         ref_y, ref_cb, ref_cr, qp,
+                         *, pad_h: int, pad_w: int, n_stripes: int,
+                         sh: int):
+    """IDR counterpart of :func:`encode_frame_p_rgb` (one dispatch)."""
+    y, cb, cr = prepare_planes(rgb, pad_h, pad_w)
+    return encode_frame_idr(y, cb, cr, prev_y, prev_cb, prev_cr,
+                            ref_y, ref_cb, ref_cr, qp,
+                            n_stripes=n_stripes, sh=sh)
+
+
+#: NO donate_argnames here, deliberately: donation measurably serializes
+#: dispatches on RPC-attached transports (8.1 → 10.4 fps when removed in
+#: round 3), and the ~15 MB/batch of un-reused plane buffers is noise
+#: against 16 GB of HBM. PCIe deployments that want donation back can
+#: re-enable it with a wrapper.
+@functools.partial(jax.jit,
+                   static_argnames=("pad_h", "pad_w", "n_stripes", "sh",
+                                    "search", "cap_frac", "prefix"))
+def encode_frame_p_batch_rgb(rgbs, prev_y, prev_cb, prev_cr,
+                             ref_y, ref_cb, ref_cr, paints, qps, paint_qp,
+                             *, pad_h: int, pad_w: int, n_stripes: int,
+                             sh: int, search: int = SEARCH,
+                             cap_frac: int = 4, prefix: int = 0):
+    """B sequential P frames in ONE device program.
+
+    RPC-attached transports pay a fixed round trip per *program
+    dispatch* — not per FLOP — and the P-frame reference chain forbids
+    overlapping separate dispatches. Carrying the chain through a
+    ``lax.scan`` *inside* one program divides the per-frame dispatch
+    cost by B: the tunnel sees one round trip per batch while the
+    device still encodes each frame against the previous frame's exact
+    reconstruction. PCIe deployments run B=1 (no added latency).
+
+    rgbs: (B, H, W, 3) uint8; paints: (B, S) int32; qps: (B,) int32.
+    Returns (heads (B, prefix), flat16s (B, S, words), last y/cb/cr,
+    new refs) — heads are the fetch-prefix slices, one per frame.
+    """
+    def step(carry, xs):
+        prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr = carry
+        rgb, paint, qp = xs
+        y, cb, cr = prepare_planes(rgb, pad_h, pad_w)
+        enc, damage, update, nry, nrcb, nrcr = _frame_p_core(
+            y, cb, cr, prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr,
+            paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search)
+        flat16, _ = _pack_levels(enc, damage, update)
+        buf = _pack_sparse(flat16, damage, update, cap_frac=cap_frac)
+        head = buf[:prefix] if prefix else buf
+        return (y, cb, cr, nry, nrcb, nrcr), (head, flat16)
+
+    carry0 = (prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr)
+    (ly, lcb, lcr, nry, nrcb, nrcr), (heads, flat16s) = jax.lax.scan(
+        step, carry0, (rgbs, paints, qps))
+    return heads, flat16s, ly, lcb, lcr, nry, nrcb, nrcr
 
 
 @functools.partial(jax.jit, static_argnames=("n_stripes", "sh"),

@@ -375,10 +375,16 @@ class PipelinedH264Encoder:
     full flat16 levels and fetch solo (they are rare: connect/reset/PLI).
     """
 
-    def __init__(self, base, depth: int = 8, fetch_group: int = 4) -> None:
+    def __init__(self, base, depth: int = 8, fetch_group: int = 4,
+                 batch: int = 1) -> None:
         self.base = base
         self.depth = depth
         self.fetch_group = max(1, fetch_group)
+        #: frames encoded per device dispatch (dev.encode_frame_p_batch_rgb)
+        #: — RPC-attached transports pay per dispatch, so batch>1 divides
+        #: that cost; PCIe deployments keep 1 (no added latency)
+        self.batch = max(1, batch)
+        self._batch_frames: List[Any] = []
         self._inflight: deque[_H264InFlight] = deque()
         self._unfetched: List[_H264InFlight] = []
         self._ready: List[Tuple[int, list]] = []
@@ -407,8 +413,17 @@ class PipelinedH264Encoder:
         return self.submit(frame)
 
     def submit(self, frame) -> int:
-        while len(self._inflight) >= self.depth:
+        while len(self._inflight) + len(self._batch_frames) >= self.depth:
+            if not self._inflight:
+                self._flush_batch()
+                continue
             self._ready.append(self._drain_one())
+        if self.batch > 1:
+            seq = self._seq + len(self._batch_frames)
+            self._batch_frames.append(frame)
+            if len(self._batch_frames) >= self.batch:
+                self._flush_batch()
+            return seq
         p = self.base.dispatch(frame, fetch=False)
         item = _H264InFlight(seq=self._seq, pending=p)
         self._seq += 1
@@ -422,12 +437,76 @@ class PipelinedH264Encoder:
                 self._issue_fetch()
         return item.seq
 
+    def submit_batch(self, rgbs) -> List[int]:
+        """Submit a pre-stacked (B, H, W, 3) array as one batch — the
+        zero-extra-dispatch path when the source can produce batches
+        (device batch sources, stacked host capture)."""
+        while len(self._inflight) >= self.depth:
+            self._ready.append(self._drain_one())
+        self._flush_batch()                  # keep ordering with singles
+        first = self._seq
+        self._dispatch_batch(rgbs)
+        return list(range(first, self._seq))
+
+    def _flush_batch(self) -> None:
+        """Dispatch the accumulated frames as one batched program; its
+        heads array doubles as the fetch group (one async read per
+        batch). Partial batches go through the already-compiled
+        single-frame program — a (B-k)-shaped batch scan would compile
+        from scratch for every distinct partial size."""
+        frames, self._batch_frames = self._batch_frames, []
+        if not frames:
+            return
+        if len(frames) < self.batch:
+            for frame in frames:
+                p = self.base.dispatch(frame, fetch=False)
+                item = _H264InFlight(seq=self._seq, pending=p)
+                self._seq += 1
+                self._inflight.append(item)
+                if p.is_idr:
+                    p.flat16.copy_to_host_async()
+                else:
+                    self._unfetched.append(item)
+            self._issue_fetch()
+            return
+        rgbs = jnp.stack([jnp.asarray(f) for f in frames])
+        self._dispatch_batch(rgbs)
+
+    def _dispatch_batch(self, rgbs) -> None:
+        pendings = self.base.dispatch_batch(rgbs, fetch=True)
+        group_items = []
+        for p in pendings:
+            item = _H264InFlight(seq=self._seq, pending=p)
+            self._seq += 1
+            self._inflight.append(item)
+            if p.is_idr:
+                p.flat16.copy_to_host_async()
+            elif p.batch_heads is not None:
+                group_items.append(item)
+            else:
+                self._unfetched.append(item)
+        if group_items:
+            arr = group_items[0].pending.batch_heads
+            group = _FetchGroup(arr=arr,
+                                stride=group_items[0].pending.head_len)
+            for it in group_items:
+                it.group = group
+                it.group_index = it.pending.batch_index
+        if self._unfetched:
+            self._issue_fetch()
+
     def _issue_fetch(self) -> None:
         group_items, self._unfetched = self._unfetched, []
         if not group_items:
             return
-        stride = self.base._sparse_guess
-        slices = [it.pending.buf[:stride] for it in group_items]
+        stride = self.base._batch_prefix
+        # the dispatch program already produced the prefix slice (one
+        # fewer program per frame); slice only when the prefix grew
+        slices = [it.pending.head
+                  if (it.pending.head is not None
+                      and it.pending.head_len == stride)
+                  else it.pending.buf[:stride]
+                  for it in group_items]
         arr = slices[0] if len(slices) == 1 else jnp.concatenate(slices)
         arr.copy_to_host_async()
         group = _FetchGroup(arr=arr, stride=stride)
@@ -451,9 +530,12 @@ class PipelinedH264Encoder:
             return False
         if item.group.host is None:
             item.group.host = np.asarray(item.group.arr)
-        stride = item.group.stride
-        item.host = item.group.host[item.group_index * stride:
-                                    (item.group_index + 1) * stride]
+        if item.group.host.ndim == 2:      # batched dispatch: (B, prefix)
+            item.host = item.group.host[item.group_index]
+        else:
+            stride = item.group.stride
+            item.host = item.group.host[item.group_index * stride:
+                                        (item.group_index + 1) * stride]
         return True
 
     def _drain_one(self) -> Tuple[int, list]:
@@ -467,6 +549,8 @@ class PipelinedH264Encoder:
         """Harvest completed frames in order; see PipelinedJpegEncoder.poll
         for the ``flush_partial`` latency/throughput trade."""
         out, self._ready = self._ready, []
+        if flush_partial and self._batch_frames:
+            self._flush_batch()
         if self._unfetched and flush_partial:
             self._issue_fetch()
         while self._inflight and self._advance(self._inflight[0],
@@ -478,11 +562,13 @@ class PipelinedH264Encoder:
 
     def flush(self) -> List[Tuple[int, list]]:
         out, self._ready = self._ready, []
+        self._flush_batch()
         while self._inflight:
             out.append(self._drain_one())
         return out
 
     def close(self) -> None:
+        self._batch_frames.clear()
         self._inflight.clear()
         self._unfetched.clear()
         self._ready.clear()

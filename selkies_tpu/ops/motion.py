@@ -88,6 +88,24 @@ def _sad_per_mb_mxu(diff_f32: jnp.ndarray, mb: int) -> jnp.ndarray:
                       jnp.asarray(b), precision=jax.lax.Precision.HIGHEST)
 
 
+def _sad_per_mb_hybrid(diff_i16: jnp.ndarray, mb: int) -> jnp.ndarray:
+    """(..., H, W) int16 abs-diff → (..., H//mb, W//mb) f32 block sums.
+
+    Row sums ride the VPU (a sublane-axis reduction, cheap) and only the
+    lane-axis column sum goes through the MXU — and with rows pre-summed
+    the matmul's M dimension is batch×(H/mb) instead of H/mb, so the
+    systolic array actually fills. The two-einsum form fed the MXU M=4
+    matmuls (one per 64-row stripe), which measured 0.5 TFLOP/s and made
+    exhaustive ME 80% of the H.264 device step. int16 row sums are exact
+    (≤ 16·255 = 4080); the f32 HIGHEST matmul is exact below 2^24.
+    """
+    *lead, h, w = diff_i16.shape
+    rows = diff_i16.reshape(*lead, h // mb, mb, w).sum(-2)
+    _, b = _block_indicators(h, w, mb)
+    return jnp.matmul(rows.astype(jnp.float32), jnp.asarray(b),
+                      precision=jax.lax.Precision.HIGHEST)
+
+
 @functools.partial(jax.jit, static_argnames=("mb", "search", "chunk"))
 def full_search_mv(cur: jnp.ndarray, ref: jnp.ndarray, *,
                    mb: int = 16, search: int = 12, chunk: int = 25):
@@ -146,10 +164,117 @@ def full_search_mv(cur: jnp.ndarray, ref: jnp.ndarray, *,
     return mv, sad0, best_sad
 
 
-@functools.partial(jax.jit, static_argnames=("mb", "search"))
+@functools.partial(jax.jit, static_argnames=("mb", "search", "chunk"))
 def full_search_mc(cur, ref, ref_cb, ref_cr, *, mb: int = 16,
+                   search: int = 12, chunk: int = 25):
+    """Fused exhaustive ME + luma/chroma MC, chunk-batched.
+
+    The separate ME → mc_luma/mc_chroma pipeline pays per-macroblock
+    gathers (vmapped dynamic_slice with per-block starts): ~3M gathered
+    elements/frame through the TPU scalar core dominated the whole H.264
+    encode. The round-2 form fixed that with a 625-iteration lax.scan —
+    but scan costs ~0.1-0.2 ms/iteration of fixed overhead (carry DMA +
+    program dispatch), which at 625 offsets was ~68 ms/frame, 80% of the
+    device step, at 0.4 TFLOP/s MXU utilization. This version processes
+    offsets in ``chunk``-sized batches inside a statically unrolled
+    Python loop: every candidate slice has a *static* start (a pure
+    copy, no scalar-core gather), each batch's SADs ride one MXU einsum,
+    and only one select per batch touches the prediction carries, so the
+    select chain stays short (n/chunk links, not n — full unrolling was
+    measured WORSE: 625-deep select chains explode live ranges).
+
+    Tie-breaking matches full_search_mv exactly: offsets are processed
+    in |dy|+|dx|-sorted order, within a batch argmin keeps the first
+    (earliest) minimum, and a strict ``<`` across batches keeps the
+    earliest global minimum — so (0,0) and near-zero motion win ties.
+
+    Returns (mv, pred_y u8, pred_cb u8, pred_cr u8).
+    """
+    h, w = cur.shape[-2:]
+    hc, wc = ref_cb.shape[-2:]
+    cb2 = mb // 2
+    nby, nbx = h // mb, w // mb
+    offs_np = _offsets(search)
+    n = offs_np.shape[0]
+    cur_i = cur.astype(jnp.int16)
+    ref_pad = pad_replicate(ref, search)             # uint8: slices stay u8
+    rc = search // 2 + 1
+    cbp = pad_replicate(ref_cb.astype(jnp.int16), rc + 1)
+    crp = pad_replicate(ref_cr.astype(jnp.int16), rc + 1)
+
+    def luma_slice(dy: int, dx: int):
+        y0, x0 = search + dy, search + dx
+        return ref_pad[..., y0:y0 + h, x0:x0 + w]
+
+    def chroma_pred(cp, dy: int, dx: int):
+        # §8.4.2.2.2: integer luma MV → {0,4}-eighth chroma bilinear;
+        # static weights mean even offsets fold to a plain slice
+        iy, ix = dy >> 1, dx >> 1
+        yf, xf = (dy & 1) * 4, (dx & 1) * 4
+        y0, x0 = rc + 1 + iy, rc + 1 + ix
+        if yf == 0 and xf == 0:
+            return cp[..., y0:y0 + hc, x0:x0 + wc]
+        a = cp[..., y0:y0 + hc + 1, x0:x0 + wc + 1]
+        tl = a[..., :hc, :wc]
+        tr = a[..., :hc, 1:]
+        bl = a[..., 1:, :wc]
+        br = a[..., 1:, 1:]
+        acc = ((8 - xf) * (8 - yf) * tl.astype(jnp.int32)
+               + xf * (8 - yf) * tr + (8 - xf) * yf * bl
+               + xf * yf * br + 32) >> 6
+        return acc.astype(jnp.int16)
+
+    def block_px(mask, cell):
+        return jnp.repeat(jnp.repeat(mask, cell, -2), cell, -1)
+
+    lead = cur.shape[:-2]
+    best_sad = jnp.full(lead + (nby, nbx), jnp.inf, jnp.float32)
+    best_idx = jnp.zeros(lead + (nby, nbx), jnp.int32)
+    py = jnp.zeros(lead + (h, w), jnp.uint8)
+    pcb = jnp.zeros(lead + (hc, wc), jnp.uint8)
+    pcr = jnp.zeros(lead + (hc, wc), jnp.uint8)
+
+    for c0 in range(0, n, chunk):
+        batch = [tuple(int(v) for v in o) for o in offs_np[c0:c0 + chunk]]
+        k = len(batch)
+        shifted = jnp.stack([luma_slice(dy, dx) for dy, dx in batch])
+        diff = jnp.abs(cur_i[None] - shifted.astype(jnp.int16))
+        sads = _sad_per_mb_hybrid(diff, mb)
+        c_best = sads.min(axis=0)
+        c_arg = sads.argmin(axis=0).astype(jnp.uint8)  # first min wins
+        # per-pixel winner index (u8) lets the one-hot compare fuse into
+        # the masked sums instead of materializing k boolean planes
+        argpx = block_px(c_arg, mb)
+        argcx = block_px(c_arg, cb2)
+        ks = jnp.arange(k, dtype=jnp.uint8)
+        kpx = ks.reshape((k,) + (1,) * argpx.ndim)
+        # exactly one k contributes per pixel → the masked sum IS a select
+        py_c = jnp.sum(jnp.where(kpx == argpx[None], shifted, 0)
+                       .astype(jnp.int16), axis=0).astype(jnp.uint8)
+        ncb = jnp.stack([chroma_pred(cbp, dy, dx) for dy, dx in batch])
+        ncr = jnp.stack([chroma_pred(crp, dy, dx) for dy, dx in batch])
+        kcx = ks.reshape((k,) + (1,) * argcx.ndim)
+        ohcx = kcx == argcx[None]
+        pcb_c = jnp.sum(jnp.where(ohcx, ncb, 0), axis=0).astype(jnp.uint8)
+        pcr_c = jnp.sum(jnp.where(ohcx, ncr, 0), axis=0).astype(jnp.uint8)
+
+        take = c_best < best_sad                      # strict: earlier wins
+        tpx = block_px(take, mb)
+        tcx = block_px(take, cb2)
+        best_idx = jnp.where(take, c_arg.astype(jnp.int32) + c0, best_idx)
+        best_sad = jnp.where(take, c_best, best_sad)
+        py = jnp.where(tpx, py_c, py)
+        pcb = jnp.where(tcx, pcb_c, pcb)
+        pcr = jnp.where(tcx, pcr_c, pcr)
+
+    mv = jnp.asarray(offs_np)[best_idx]              # tiny [nby, nbx] take
+    return mv, py, pcb, pcr
+
+
+@functools.partial(jax.jit, static_argnames=("mb", "search"))
+def full_search_mc_scan(cur, ref, ref_cb, ref_cr, *, mb: int = 16,
                    search: int = 12):
-    """Fused exhaustive ME + luma/chroma MC in ONE scan over offsets.
+    """Round-2 scan formulation of the fused search (selectable backend).
 
     The separate ME → mc_luma/mc_chroma pipeline pays per-macroblock
     gathers (vmapped dynamic_slice with per-block starts): ~3M gathered
@@ -230,6 +355,7 @@ def full_search_mc(cur, ref, ref_cb, ref_cr, *, mb: int = 16,
         body, init, (offs, jnp.arange(n, dtype=jnp.int32)))
     mv = offs[best_idx]                              # tiny [nby, nbx] take
     return mv, py, pcb, pcr
+
 
 
 @functools.partial(jax.jit, static_argnames=("mb", "search"))

@@ -1,0 +1,210 @@
+"""Per-stage device-time attribution for the tpuenc H.264 path (config 2).
+
+VERDICT r2 item 1: the 5× fps gap against BASELINE config 2 (60 fps
+1080p H.264) was unattributed — this tool separates where a frame's time
+actually goes, so "lifts on PCIe" claims are measured, not asserted:
+
+  * ``sync_floor_ms``   — cost of one trivial dispatch + host sync on this
+    transport (the tunnel's ~100 ms RPC floor; ~0 on PCIe). Every *timing*
+    below amortizes it by chaining N async dispatches per one sync.
+  * ``me_mc_ms``        — the fused exhaustive ME + MC scan alone
+    (ops/pallas_me.py me_mc_stripes, VMEM-resident kernel).
+  * ``pack_ms``         — block-sparse level pack alone (_pack_sparse).
+  * ``full_step_ms``    — the complete device program the product runs per
+    P frame (prepare_planes + ME/MC + transform/quant/recon + pack), i.e.
+    the tunnel-excluded device-side frame cost. ``device_fps`` = 1000/this.
+  * ``transform_ms``    — derived: full − ME/MC − pack (transform, quant,
+    reconstruction, damage select, color conversion).
+  * ``d2h_ms``          — wall time to fetch one typical sparse buffer
+    (transport-bound on the tunnel; the pipeline overlaps several).
+  * ``cavlc_ms``        — host entropy coding of one fetched frame.
+  * ``me_tflops``       — analytic FLOP count of the SAD search divided by
+    measured ME time (device-utilization estimate for the MXU portion).
+
+Shared-chip protocol: each timing is best-of-``repeats`` (the tunnel's
+timings swing ±40% with contention; the minimum is the least-contended
+estimate — BASELINE.md round-2 variance note).
+
+Run: ``python tools/h264_stages.py [--frames N]`` → one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+W, H = 1920, 1080
+
+
+def _best_of(fn, repeats: int):
+    vals = []
+    for _ in range(repeats):
+        vals.append(fn())
+    return min(vals), vals
+
+
+def measure(frames: int = 12, repeats: int = 3, width: int = W,
+            height: int = H) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from selkies_tpu.capture.synthetic import DeviceScrollSource
+    from selkies_tpu.encoder import h264_device as dev
+    from selkies_tpu.encoder.h264 import H264StripeEncoder
+
+    enc = H264StripeEncoder(width, height)
+    src = DeviceScrollSource(width, enc.pad_h)
+    S, sh = enc.n_stripes, enc.stripe_h
+
+    def nxt():
+        return src.next_frame()
+
+    # ---- sync floor: a trivial program + one host sync ------------------
+    tiny = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8, 128), jnp.float32)
+    tiny(x).block_until_ready()
+
+    def run_floor():
+        t0 = time.perf_counter()
+        tiny(x).block_until_ready()
+        return (time.perf_counter() - t0) * 1000.0
+
+    sync_floor_ms, floor_runs = _best_of(run_floor, max(repeats, 5))
+
+    # ---- full device step (the product P-frame program) -----------------
+    # chain `frames` dispatches through the encoder's own state, then one
+    # sync: per-frame cost ≈ (total − sync floor) / frames
+    enc.encode_frame(nxt())          # IDR + compile
+    enc.encode_frame(nxt())          # P compile
+    pend = None
+
+    def run_full():
+        nonlocal pend
+        t0 = time.perf_counter()
+        for _ in range(frames):
+            pend = enc.dispatch(nxt(), fetch=False)
+        pend.flat16.block_until_ready()
+        return ((time.perf_counter() - t0) * 1000.0 - sync_floor_ms) / frames
+
+    full_step_ms, full_runs = _best_of(run_full, repeats)
+
+    # ---- fused ME/MC kernel alone ---------------------------------------
+    from selkies_tpu.ops.pallas_me import me_mc_stripes
+    y, cb, cr = dev.prepare_planes(nxt(), enc.pad_h, enc.pad_w)
+    ys = y.reshape(S, sh, enc.pad_w)
+    cbs = cb.reshape(S, sh // 2, enc.pad_w // 2)
+    crs = cr.reshape(S, sh // 2, enc.pad_w // 2)
+    me = functools.partial(me_mc_stripes, search=enc.search)
+    me(ys, ys, cbs, crs)[0].block_until_ready()    # compile
+
+    def run_me():
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(frames):
+            out = me(ys, ys, cbs, crs)
+        out[0].block_until_ready()
+        return ((time.perf_counter() - t0) * 1000.0 - sync_floor_ms) / frames
+
+    me_mc_ms, me_runs = _best_of(run_me, repeats)
+
+    # ---- sparse pack alone ----------------------------------------------
+    words = enc._stripe_words
+    rng = np.random.default_rng(0)
+    f16 = np.zeros((S, words), np.int16)
+    nz = rng.random((S, words)) < 0.02             # typical sparsity
+    f16[nz] = rng.integers(-40, 41, int(nz.sum()))
+    f16j = jnp.asarray(f16)
+    damage = jnp.ones((S,), bool)
+    pack = jax.jit(functools.partial(dev._pack_sparse, cap_frac=4))
+    pack(f16j, damage, damage).block_until_ready()
+
+    def run_pack():
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(frames):
+            out = pack(f16j, damage, damage)
+        out.block_until_ready()
+        return ((time.perf_counter() - t0) * 1000.0 - sync_floor_ms) / frames
+
+    pack_ms, pack_runs = _best_of(run_pack, repeats)
+
+    # ---- D2H of one typical sparse prefix -------------------------------
+    # distinct device arrays per read (a repeated read of the same array
+    # is host-cached and measures nothing), all computed before the timer
+    # so only the transfer is on the clock
+    buf = pack(f16j, damage, damage)
+    n_reads = max(repeats, 5)
+    prefixes = [(buf[:enc._sparse_guess] + jnp.uint8(i))
+                for i in range(n_reads)]
+    for p_ in prefixes:
+        p_.block_until_ready()
+    d2h_runs = []
+    for p_ in prefixes:
+        t0 = time.perf_counter()
+        np.asarray(p_)
+        d2h_runs.append((time.perf_counter() - t0) * 1000.0)
+    d2h_ms = min(d2h_runs)
+
+    # ---- host CAVLC for one frame's typical stripes ---------------------
+    # fetch first (off the clock), then time only the entropy coding
+    pend = enc.dispatch(nxt(), fetch=True)
+    host = np.asarray(pend.fetch)
+    t0 = time.perf_counter()
+    stripes = enc.harvest(pend, host=host)
+    cavlc_ms = (time.perf_counter() - t0) * 1000.0
+
+    # ---- analytic FLOPs of the SAD search (MXU utilization) -------------
+    n_offsets = (2 * enc.search + 1) ** 2
+    nby, nbx = sh // 16, enc.pad_w // 16
+    # per offset per stripe: abs-diff (sh*W) + two indicator matmuls
+    flops_per_offset = S * (2 * nby * sh * enc.pad_w      # A @ |d|
+                            + 2 * nby * enc.pad_w * nbx)  # (…) @ B
+    me_flops = n_offsets * flops_per_offset
+    me_tflops = me_flops / (me_mc_ms / 1000.0) / 1e12 if me_mc_ms > 0 else 0
+
+    transform_ms = max(0.0, full_step_ms - me_mc_ms - pack_ms)
+    return {
+        "sync_floor_ms": round(sync_floor_ms, 2),
+        "full_step_ms": round(full_step_ms, 2),
+        "me_mc_ms": round(me_mc_ms, 2),
+        "pack_ms": round(pack_ms, 2),
+        "transform_ms": round(transform_ms, 2),
+        "d2h_ms": round(d2h_ms, 2),
+        "cavlc_ms": round(cavlc_ms, 2),
+        "device_fps": round(1000.0 / full_step_ms, 2)
+        if full_step_ms > 0 else None,
+        "me_tflops": round(me_tflops, 2),
+        "n_offsets": n_offsets,
+        "stripes_out": len(stripes),
+        "spread": {
+            "full_step_ms": [round(v, 2) for v in full_runs],
+            "me_mc_ms": [round(v, 2) for v in me_runs],
+            "pack_ms": [round(v, 2) for v in pack_runs],
+            "sync_floor_ms": [round(v, 2) for v in floor_runs],
+            "d2h_ms": [round(v, 2) for v in d2h_runs],
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frames", type=int, default=12)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--width", type=int, default=W)
+    ap.add_argument("--height", type=int, default=H)
+    args = ap.parse_args()
+    out = measure(frames=args.frames, repeats=args.repeats,
+                  width=args.width, height=args.height)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
