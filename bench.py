@@ -140,13 +140,48 @@ def bench_h264() -> dict:
 
 
 def bench_4k() -> dict:
-    """Config 4 single-chip share: 4K JPEG-stripe throughput."""
+    """Config 4 single-chip share: 4K JPEG + 4K H.264 throughput.
+
+    The v5e-4 target (30 fps) rides the stripe-axis mesh shard
+    (parallel/, validated by __graft_entry__.dryrun_multichip); the
+    per-chip numbers here scale ~linearly with chip count because
+    stripes are independent sequences."""
     fps, done, elapsed, total = _pipelined_jpeg_fps(
-        3840, 2160, 120, MAX_SECONDS / 3)
-    return {
+        3840, 2160, 120, MAX_SECONDS / 4)
+    out = {
         "fourk_jpeg_fps": round(fps, 2),
         "fourk_mean_frame_kb": round(total / max(done, 1) / 1024, 1),
     }
+    try:
+        from selkies_tpu.capture.synthetic import DeviceScrollSource
+        from selkies_tpu.encoder.h264 import H264StripeEncoder
+        from selkies_tpu.encoder.pipeline import PipelinedH264Encoder
+
+        B = 8
+        enc = H264StripeEncoder(3840, 2160)
+        src = DeviceScrollSource(3840, enc.pad_h)
+        pipe = PipelinedH264Encoder(enc, depth=3 * B, batch=B)
+        enc.encode_frame(src.next_frame())
+        enc.encode_frame(src.next_frame())
+        for _ in range(3):                   # compile + prefix settle
+            pipe.submit_batch(src.next_batch(B))
+            for _ in pipe.poll(flush_partial=False):
+                pass
+        for _ in pipe.flush():
+            pass
+        done = 0
+        start = time.perf_counter()
+        while done < 150 and time.perf_counter() - start < MAX_SECONDS / 4:
+            pipe.submit_batch(src.next_batch(B))
+            for _seq, _o in pipe.poll(flush_partial=False):
+                done += 1
+        for _seq, _o in pipe.flush():
+            done += 1
+        el = time.perf_counter() - start
+        out["fourk_h264_fps"] = round(done / el, 2) if el > 0 else 0.0
+    except Exception as e:
+        out["fourk_h264_error"] = repr(e)
+    return out
 
 
 def bench_glass_to_glass() -> dict:
@@ -168,9 +203,11 @@ def bench_glass_to_glass() -> dict:
     from selkies_tpu.capture.synthetic import SyntheticSource
     from selkies_tpu.server.data_server import default_encoder_factory
 
-    #: wire frame id → capture-handoff time. The wrapper mirrors the
-    #: capture loop's id assignment exactly: ids are handed to non-empty
-    #: results in poll order, which is submission order.
+    #: wire frame id → (capture-handoff time, harvest time). The wrapper
+    #: mirrors the capture loop's id assignment exactly: ids are handed
+    #: to non-empty results in poll order, which is submission order.
+    #: The harvest stamp splits the end-to-end number into the encode
+    #: share (dispatch → levels on host) vs the serve/transport share.
     fid_times = {}
 
     class TimedEncoder:
@@ -189,10 +226,11 @@ def bench_glass_to_glass() -> dict:
 
         def poll(self):
             out = self.inner.poll()
+            now = time.monotonic()
             for seq, stripes in out:
                 t = self._t.pop(seq, None)
                 if stripes and t is not None:
-                    fid_times[self._next_fid] = t
+                    fid_times[self._next_fid] = (t, now)
                     self._next_fid += 1
             return out
 
@@ -255,10 +293,16 @@ def bench_glass_to_glass() -> dict:
                 seen.add(f.frame_id)
                 # decode one stripe as the browser-ImageDecoder stand-in;
                 # latency = capture handoff → stripe decodable client-side
+                t_recv = time.monotonic()
                 Image.open(io.BytesIO(f.payload)).load()
-                t0 = fid_times.get(f.frame_id)
-                if t0 is not None:
-                    lat_ms.append((time.monotonic() - t0) * 1000.0)
+                t_dec = time.monotonic()
+                stamps = fid_times.get(f.frame_id)
+                if stamps is not None:
+                    t0, t_harvest = stamps
+                    lat_ms.append(((t_dec - t0) * 1000.0,
+                                   (t_harvest - t0) * 1000.0,
+                                   (t_recv - t_harvest) * 1000.0,
+                                   (t_dec - t_recv) * 1000.0))
                 await ws.send(f"CLIENT_FRAME_ACK {f.frame_id}")
         await server.stop()
         srv.close()
@@ -268,28 +312,51 @@ def bench_glass_to_glass() -> dict:
     samples = lat_ms[20:] if len(lat_ms) > 40 else lat_ms
     if not samples:
         return {"p50_glass_to_glass_ms": None}
-    arr = np.sort(np.asarray(samples))
+    arr = np.asarray(samples)   # [total, encode, serve, client_decode]
+
+    def pct(col, q):
+        vals = np.sort(arr[:, col])
+        return round(float(vals[min(len(vals) - 1,
+                                    int(len(vals) * q / 100))]), 1)
+
     return {
-        "p50_glass_to_glass_ms": round(float(arr[len(arr) // 2]), 1),
-        "p95_glass_to_glass_ms": round(float(arr[int(len(arr) * 0.95)]), 1),
+        "p50_glass_to_glass_ms": pct(0, 50),
+        "p95_glass_to_glass_ms": pct(0, 95),
+        # stage decomposition (VERDICT r2 item 3): the encode stage is
+        # capture handoff → levels on host (device dispatch + D2H — the
+        # transport-bound share on the tunnel, sub-frame on PCIe); serve
+        # is host assembly + websocket; decode is the client-side share
+        "encode_only_p50_ms": pct(1, 50),
+        "encode_only_p95_ms": pct(1, 95),
+        "serve_p50_ms": pct(2, 50),
+        "client_decode_p50_ms": pct(3, 50),
         "latency_samples": len(arr),
-        # each hop (6 MB capture H2D, metadata/bitstream D2H) pays a fixed
-        # ~25-350 ms RPC on the tunneled dev chip; on PCIe the same hops
-        # are sub-millisecond, so this number is transport-bound here
-        "latency_note": "tunneled-transport RPC floor dominates",
+        "latency_note": "encode share is tunnel-RPC-bound on this dev "
+                        "chip; serve+decode shares are transport-free",
     }
 
 
 def main() -> None:
-    fps, done, elapsed, total_bytes = _pipelined_jpeg_fps(
-        W, H, BENCH_FRAMES, MAX_SECONDS)
+    # median-of-N protocol (VERDICT r2 item 8): the shared dev chip's
+    # timings swing ±40% with contention, so the headline is the median
+    # of three shorter runs with the spread published alongside
+    runs = []
+    total_bytes = done = 0
+    for _ in range(3):
+        fps, d, _el, tb = _pipelined_jpeg_fps(
+            W, H, BENCH_FRAMES // 3, MAX_SECONDS / 4)
+        runs.append(round(fps, 2))
+        done += d
+        total_bytes += tb
+    med = sorted(runs)[1]
     result = {
         "metric": "tpuenc_jpeg_1080p_encode_fps",
-        "value": round(fps, 2),
+        "value": med,
         "unit": "fps",
-        "vs_baseline": round(fps / BASELINE_FPS, 3),
+        "vs_baseline": round(med / BASELINE_FPS, 3),
+        "runs": runs,
+        "spread": round(max(runs) - min(runs), 2),
         "frames": done,
-        "elapsed_s": round(elapsed, 2),
         "mean_frame_kb": round(total_bytes / max(done, 1) / 1024, 1),
     }
     try:

@@ -303,8 +303,24 @@ class H264StripeEncoder:
         self._sparse_guess = self._bucket(self._fixed_bytes + (64 << 10))
         #: batch dispatches need a STABLE static prefix — an adaptive one
         #: recompiles the (expensive) batched program on every bucket
-        #: move. Undershoot falls back to the exact flat16 rows.
-        self._batch_prefix = self._bucket(self._fixed_bytes + (96 << 10))
+        #: move. Undershoot falls back to the exact flat16 rows and grows
+        #: the prefix (bounded recompiles). Sized to cover worst-case
+        #: full-damage content at streaming QPs (~1/20 of the pixel
+        #: count in sparse cells, measured on the scroll source).
+        self._batch_prefix = self._bucket(
+            self._fixed_bytes + max(96 << 10, self.pad_h * self.pad_w // 20))
+        #: small prefix for static/quiet content — most desktop frames
+        #: need only the fixed bitmap head, and shipping the worst-case
+        #: head every frame would cost 10-30x the D2H bytes
+        self._prefix_small = self._bucket(self._fixed_bytes + 4096)
+
+    def _choose_prefix(self) -> int:
+        """Pick between the two compiled head sizes from the adaptive
+        estimate harvest maintains (_sparse_guess tracks ~1.5x the last
+        frame's needed bytes)."""
+        if self._sparse_guess <= self._prefix_small:
+            return self._prefix_small
+        return self._batch_prefix
 
     def _bucket(self, nbytes: int) -> int:
         """Power-of-two fetch prefix (bounds distinct slice executables)."""
@@ -376,10 +392,12 @@ class H264StripeEncoder:
                     jnp.int32(self.qp), jnp.int32(self.paint_over_qp),
                     pad_h=self.pad_h, pad_w=self.pad_w,
                     n_stripes=self.n_stripes, sh=self.stripe_h,
-                    # pinned prefix: an adaptive one is a *static* arg,
-                    # so every bucket move would recompile this whole
-                    # program mid-stream; undershoot re-reads from buf
-                    search=self.search, prefix=self._batch_prefix)
+                    # two-tier prefix: static content ships the small
+                    # head, busy content the sized one — two compiled
+                    # programs, no per-bucket recompile churn; undershoot
+                    # re-reads from buf
+                    search=self.search, prefix=self._choose_prefix(),
+                    me=dev._me_backend())
             pending_buf = buf
             fetch_arr = head if fetch else None
         if fetch_arr is not None:
@@ -387,7 +405,8 @@ class H264StripeEncoder:
         qp_arr = np.where(paint != 0, self.paint_over_qp, self.qp)
         return _H264Pending(fetch=fetch_arr, flat16=flat16, is_idr=is_idr,
                             paint=paint, qp=qp_arr, buf=pending_buf,
-                            head=head, head_len=self._batch_prefix)
+                            head=head,
+                            head_len=0 if is_idr else int(head.shape[0]))
 
     def dispatch_batch(self, rgbs, fetch: bool = True
                        ) -> List["_H264Pending"]:
@@ -412,7 +431,7 @@ class H264StripeEncoder:
                     paints[b, i] = 1
                     st.painted_over = True
         qps = np.where(paints != 0, self.paint_over_qp, self.qp)
-        prefix = self._batch_prefix
+        prefix = self._choose_prefix()
         (heads, flat16s, self._prev_y, self._prev_cb, self._prev_cr,
          self._ref_y, self._ref_cb, self._ref_cr) = \
             dev.encode_frame_p_batch_rgb(
@@ -424,7 +443,8 @@ class H264StripeEncoder:
                 jnp.int32(self.paint_over_qp),
                 pad_h=self.pad_h, pad_w=self.pad_w,
                 n_stripes=self.n_stripes, sh=self.stripe_h,
-                search=self.search, prefix=prefix)
+                search=self.search, prefix=prefix,
+                me=dev._me_backend())
         if fetch:
             heads.copy_to_host_async()
         cache: Dict[str, np.ndarray] = {}   # shared host copy of heads
@@ -475,10 +495,14 @@ class H264StripeEncoder:
                     # recompiles are bounded) so high-entropy content
                     # doesn't pay this cliff on every future batch
                     ovf = ovf | damage | (p.paint != 0)
-                    self._batch_prefix = min(
-                        self._buf_bytes,
-                        max(self._batch_prefix,
-                            self._bucket(needed + needed // 2)))
+                    if len(host) >= self._batch_prefix:
+                        # undershoot at the LARGE prefix: worst-case head
+                        # really is bigger — grow it (bounded recompiles).
+                        # An undershoot at the small tier just means the
+                        # scene got busy; the guess below re-tiers it.
+                        self._batch_prefix = min(
+                            self._buf_bytes,
+                            self._bucket(needed + needed // 2))
             self._sparse_guess = self._bucket(
                 max(needed + needed // 2, self._fixed_bytes + 4096))
             bitmaps = host[4 * S:self._fixed_bytes] \

@@ -230,7 +230,8 @@ def _stripe_view(plane, n_stripes, sh):
 
 def _frame_p_core(y, cb, cr, prev_y, prev_cb, prev_cr,
                   ref_y, ref_cb, ref_cr, paint, qp, paint_qp,
-                  *, n_stripes: int, sh: int, search: int):
+                  *, n_stripes: int, sh: int, search: int,
+                  me: str = "pallas"):
     """Shared body of the dense whole-frame P encode: every stripe in ONE
     dispatch.
 
@@ -265,12 +266,11 @@ def _frame_p_core(y, cb, cr, prev_y, prev_cb, prev_cr,
     # tunneled dev transport, per-dispatch RPC overhead — not device
     # compute — decides end-to-end fps, and the two backends trade
     # differently there.
-    backend = _me_backend()
-    if backend == "pallas":
+    if me == "pallas":
         mv, pred_y, pred_cb, pred_cr = me_mc_stripes(
             ys, rys, rcbs, rcrs, search=search)
     else:
-        fn = full_search_mc_scan if backend == "scan" else full_search_mc
+        fn = full_search_mc_scan if me == "scan" else full_search_mc
         mv, pred_y, pred_cb, pred_cr = jax.vmap(
             functools.partial(fn, mb=MB, search=search)
         )(ys, rys, rcbs, rcrs)
@@ -285,18 +285,20 @@ def _frame_p_core(y, cb, cr, prev_y, prev_cb, prev_cr,
     return enc, damage, update, new_ref_y, new_ref_cb, new_ref_cr
 
 
-@functools.partial(jax.jit, static_argnames=("n_stripes", "sh", "search"),
+@functools.partial(jax.jit, static_argnames=("n_stripes", "sh", "search", "me"),
                    donate_argnames=("prev_y", "prev_cb", "prev_cr",
                                     "ref_y", "ref_cb", "ref_cr"))
 def encode_frame_p(y, cb, cr, prev_y, prev_cb, prev_cr,
                    ref_y, ref_cb, ref_cr, paint, qp, paint_qp,
-                   *, n_stripes: int, sh: int, search: int = SEARCH):
+                   *, n_stripes: int, sh: int, search: int = SEARCH,
+                   me: str = "pallas"):
     """Dense P encode returning (flat8, flat16, ...): flat8 is the
     i8-packed coefficient buffer + per-stripe damage/overflow tail, flat16
     the exact levels for rare |level|>127 stripes."""
     enc, damage, update, new_ref_y, new_ref_cb, new_ref_cr = _frame_p_core(
         y, cb, cr, prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr,
-        paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search)
+        paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search,
+        me=me)
     flat16, flat8 = _pack_levels(enc, damage, update)
     return flat8, flat16, y, cb, cr, new_ref_y, new_ref_cb, new_ref_cr
 
@@ -377,19 +379,20 @@ def _pack_sparse(flat16, damage, update, cap_frac: int = 4):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("n_stripes", "sh", "search", "cap_frac"),
+                   static_argnames=("n_stripes", "sh", "search", "cap_frac", "me"),
                    donate_argnames=("prev_y", "prev_cb", "prev_cr",
                                     "ref_y", "ref_cb", "ref_cr"))
 def encode_frame_p_sparse(y, cb, cr, prev_y, prev_cb, prev_cr,
                           ref_y, ref_cb, ref_cr, paint, qp, paint_qp,
                           *, n_stripes: int, sh: int, search: int = SEARCH,
-                          cap_frac: int = 4):
+                          cap_frac: int = 4, me: str = "pallas"):
     """P encode with the block-sparse transfer: returns (sparse_buf,
     flat16, new state...). sparse_buf layout is documented on
     :func:`_pack_sparse`; flat16 backs per-stripe overflow re-reads."""
     enc, damage, update, new_ref_y, new_ref_cb, new_ref_cr = _frame_p_core(
         y, cb, cr, prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr,
-        paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search)
+        paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search,
+        me=me)
     flat16, _ = _pack_levels(enc, damage, update)
     buf = _pack_sparse(flat16, damage, update, cap_frac=cap_frac)
     return buf, flat16, y, cb, cr, new_ref_y, new_ref_cb, new_ref_cr
@@ -397,14 +400,14 @@ def encode_frame_p_sparse(y, cb, cr, prev_y, prev_cb, prev_cr,
 
 @functools.partial(jax.jit,
                    static_argnames=("pad_h", "pad_w", "n_stripes", "sh",
-                                    "search", "cap_frac", "prefix"),
+                                    "search", "cap_frac", "prefix", "me"),
                    donate_argnames=("prev_y", "prev_cb", "prev_cr",
                                     "ref_y", "ref_cb", "ref_cr"))
 def encode_frame_p_rgb(rgb, prev_y, prev_cb, prev_cr,
                        ref_y, ref_cb, ref_cr, paint, qp, paint_qp,
                        *, pad_h: int, pad_w: int, n_stripes: int, sh: int,
                        search: int = SEARCH, cap_frac: int = 4,
-                       prefix: int = 0):
+                       prefix: int = 0, me: str = "pallas"):
     """Whole per-frame P program in ONE dispatch: RGB→planes, damage,
     ME/MC, transform/quant/recon, sparse pack, and the fetch-prefix slice.
 
@@ -417,7 +420,8 @@ def encode_frame_p_rgb(rgb, prev_y, prev_cb, prev_cr,
     y, cb, cr = prepare_planes(rgb, pad_h, pad_w)
     enc, damage, update, new_ref_y, new_ref_cb, new_ref_cr = _frame_p_core(
         y, cb, cr, prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr,
-        paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search)
+        paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search,
+        me=me)
     flat16, _ = _pack_levels(enc, damage, update)
     buf = _pack_sparse(flat16, damage, update, cap_frac=cap_frac)
     head = buf[:prefix] if prefix else buf
@@ -447,12 +451,13 @@ def encode_frame_idr_rgb(rgb, prev_y, prev_cb, prev_cr,
 #: re-enable it with a wrapper.
 @functools.partial(jax.jit,
                    static_argnames=("pad_h", "pad_w", "n_stripes", "sh",
-                                    "search", "cap_frac", "prefix"))
+                                    "search", "cap_frac", "prefix", "me"))
 def encode_frame_p_batch_rgb(rgbs, prev_y, prev_cb, prev_cr,
                              ref_y, ref_cb, ref_cr, paints, qps, paint_qp,
                              *, pad_h: int, pad_w: int, n_stripes: int,
                              sh: int, search: int = SEARCH,
-                             cap_frac: int = 4, prefix: int = 0):
+                             cap_frac: int = 4, prefix: int = 0,
+                             me: str = "pallas"):
     """B sequential P frames in ONE device program.
 
     RPC-attached transports pay a fixed round trip per *program
@@ -473,7 +478,8 @@ def encode_frame_p_batch_rgb(rgbs, prev_y, prev_cb, prev_cr,
         y, cb, cr = prepare_planes(rgb, pad_h, pad_w)
         enc, damage, update, nry, nrcb, nrcr = _frame_p_core(
             y, cb, cr, prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr,
-            paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search)
+            paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search,
+        me=me)
         flat16, _ = _pack_levels(enc, damage, update)
         buf = _pack_sparse(flat16, damage, update, cap_frac=cap_frac)
         head = buf[:prefix] if prefix else buf

@@ -39,8 +39,11 @@ class _FetchGroup:
     sets the fetch ceiling."""
 
     arr: Any                        # device concat, one async host copy
-    stride: int                     # words per member (meta + guess)
+    stride: int = 0                 # uniform member size, when applicable
     host: Optional[np.ndarray] = None
+    #: per-member (start, length) when member sizes differ (the H.264
+    #: two-tier head prefixes); empty → uniform stride slicing
+    offsets: Tuple[Tuple[int, int], ...] = ()
 
 
 @dataclass
@@ -473,7 +476,10 @@ class PipelinedH264Encoder:
         self._dispatch_batch(rgbs)
 
     def _dispatch_batch(self, rgbs) -> None:
-        pendings = self.base.dispatch_batch(rgbs, fetch=True)
+        # fetch=False: this pipeline owns every transfer — the encoder
+        # starting its own head copies AND _issue_fetch concatenating the
+        # same heads would double-transfer the IDR-recovery path
+        pendings = self.base.dispatch_batch(rgbs, fetch=False)
         group_items = []
         for p in pendings:
             item = _H264InFlight(seq=self._seq, pending=p)
@@ -487,8 +493,8 @@ class PipelinedH264Encoder:
                 self._unfetched.append(item)
         if group_items:
             arr = group_items[0].pending.batch_heads
-            group = _FetchGroup(arr=arr,
-                                stride=group_items[0].pending.head_len)
+            arr.copy_to_host_async()
+            group = _FetchGroup(arr=arr)
             for it in group_items:
                 it.group = group
                 it.group_index = it.pending.batch_index
@@ -499,17 +505,23 @@ class PipelinedH264Encoder:
         group_items, self._unfetched = self._unfetched, []
         if not group_items:
             return
-        stride = self.base._batch_prefix
-        # the dispatch program already produced the prefix slice (one
-        # fewer program per frame); slice only when the prefix grew
-        slices = [it.pending.head
-                  if (it.pending.head is not None
-                      and it.pending.head_len == stride)
-                  else it.pending.buf[:stride]
-                  for it in group_items]
+        # the dispatch program already produced each frame's prefix slice
+        # (one fewer program per frame); members may have different sizes
+        # (two-tier head prefixes), so the group records per-member
+        # offsets instead of assuming a uniform stride
+        slices = []
+        offsets = []
+        pos = 0
+        for it in group_items:
+            s = it.pending.head if it.pending.head is not None \
+                else it.pending.buf[:self.base._batch_prefix]
+            n = int(s.shape[0])
+            slices.append(s)
+            offsets.append((pos, n))
+            pos += n
         arr = slices[0] if len(slices) == 1 else jnp.concatenate(slices)
         arr.copy_to_host_async()
-        group = _FetchGroup(arr=arr, stride=stride)
+        group = _FetchGroup(arr=arr, offsets=tuple(offsets))
         for i, it in enumerate(group_items):
             it.group = group
             it.group_index = i
@@ -532,6 +544,9 @@ class PipelinedH264Encoder:
             item.group.host = np.asarray(item.group.arr)
         if item.group.host.ndim == 2:      # batched dispatch: (B, prefix)
             item.host = item.group.host[item.group_index]
+        elif item.group.offsets:
+            start, length = item.group.offsets[item.group_index]
+            item.host = item.group.host[start:start + length]
         else:
             stride = item.group.stride
             item.host = item.group.host[item.group_index * stride:

@@ -244,6 +244,11 @@ def me_mc_stripes(cur, ref, ref_cb, ref_cr, *, search: int = 12,
             pltpu.VMEM((max(8, nby), max(128, nbx)), jnp.int32),
             pltpu.VMEM((max(8, nby), max(128, nbx)), jnp.int32),
         ],
+        # 4K stripes (w=3840) need ~18 MB of scoped VMEM (the rolled
+        # int32 window + the indicator constants); the default 16 MB
+        # scope is conservative, not the physical limit
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(ranks, cur, ref_pad, cbp, crp)
     mv = jnp.asarray(_offsets(search))[rank_w]            # (S, nby, nbx, 2)

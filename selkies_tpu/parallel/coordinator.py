@@ -103,6 +103,7 @@ class MeshEncodeCoordinator:
         self._results: Dict[int, List] = {}      # slot -> [(seq, stripes)]
         self._seq: Dict[int, int] = {}
         self._want_key: set = set()
+        self._want_reset: set = set()
         self._inflight: Tuple[Optional[Any], List[int]] = (None, [])
         self._inflight_slots: set = set()
         self._kick = threading.Event()
@@ -130,8 +131,10 @@ class MeshEncodeCoordinator:
             self._results[slot] = []
             self._seq[slot] = 0
             # applied at tick time: the worker may be mid-dispatch and the
-            # encoder's host state is not safe to touch from here
-            self._want_key.add(slot)
+            # encoder's host state is not safe to touch from here. A new
+            # occupant gets a full reset (zeroed prev planes), not just a
+            # keyframe — stale pixels must not leak across occupants.
+            self._want_reset.add(slot)
         self._ensure_thread()
         return MeshSessionFacade(self, slot)
 
@@ -216,6 +219,10 @@ class MeshEncodeCoordinator:
         hidden behind the next tick's work (depth-1 pipeline, same idea
         as PipelinedJpegEncoder)."""
         with self._lock:
+            for slot in self._want_reset:
+                if slot in self._attached or slot in self._free:
+                    self.enc.reset_session(slot)
+            self._want_reset.clear()
             for slot in self._want_key:
                 if slot in self._attached or slot in self._free:
                     self.enc.force_keyframe(slot)

@@ -362,8 +362,17 @@ class MeshStripeEncoder:
 
     def reset_session(self, session: int) -> None:
         """Recycle a slot for a new session: fresh damage history AND a
-        zeroed prev frame so no stale pixels leak across occupants."""
+        zeroed prev frame so no stale pixels leak across occupants.
+
+        force_keyframe alone is NOT enough the day an inter profile
+        rides the mesh (VERDICT r2 weak item 6): the previous occupant's
+        pixels would persist in the prev/reference planes and in the
+        idle-tick re-present buffer."""
         self.force_keyframe(session)
+        self._last_host[session] = 0
+        self._prev = jax.device_put(
+            jnp.asarray(self._prev).at[session].set(0),
+            self._frame_sharding)
 
     # -- per-tick ----------------------------------------------------------
 

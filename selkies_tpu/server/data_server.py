@@ -21,7 +21,7 @@ import logging
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional, Set
+from typing import Any, Callable, Dict, Optional, Set, Tuple
 
 from ..protocol.wire import (
     FrameId,
@@ -169,10 +169,23 @@ class DataStreamingServer:
         self.audio_pipeline = None  # wired by main() when audio is enabled
         self._audio_wanted = True   # cleared by STOP_AUDIO until re-requested
         self._last_layout = None    # last xrandr-applied Layout (dedup)
-        #: mesh-batched encode (tpu_mesh setting, BASELINE config 5):
-        #: lazily built from the first display's geometry
-        self.mesh_coordinator = None
-        self._mesh_unavailable = False
+        #: mesh-batched encode (tpu_mesh setting, BASELINE configs 4/5):
+        #: one coordinator per display geometry, lazily built — a
+        #: mismatched-resolution join gets its own bucket instead of a
+        #: silent solo fallback (VERDICT r2 item 6)
+        self.mesh_coordinators: Dict[Tuple[int, int], Any] = {}
+        #: geometries whose coordinator construction failed — scoped per
+        #: geometry so one bad bucket (e.g. a transient OOM at 4K) does
+        #: not disable mesh batching for healthy buckets
+        self._mesh_failed_geoms: Set[Tuple[int, int]] = set()
+        #: counters surfaced in the stats JSON so mesh fallbacks are
+        #: observable, not silent
+        self.mesh_stats = {"bucketed": 0, "solo_fallback": 0}
+
+    @property
+    def mesh_coordinator(self):
+        """First (primary-geometry) coordinator — back-compat accessor."""
+        return next(iter(self.mesh_coordinators.values()), None)
 
     # ------------------------------------------------------------------
     # broadcast primitives
@@ -218,8 +231,9 @@ class DataStreamingServer:
     async def stop(self) -> None:
         for st in list(self.display_clients.values()):
             await self._stop_display(st)
-        if self.mesh_coordinator is not None:
-            self.mesh_coordinator.stop()
+        for coord in self.mesh_coordinators.values():
+            coord.stop()
+        self.mesh_coordinators.clear()
         if self.audio_pipeline is not None:
             await self.audio_pipeline.stop()
             self.audio_pipeline.close()
@@ -671,7 +685,7 @@ class DataStreamingServer:
         or slot exhaustion fall back to a solo encoder per display.
         """
         spec = str(self.settings.tpu_mesh)
-        if not spec or self._mesh_unavailable:
+        if not spec:
             return None
         profile = st.overrides.get("encoder", self.settings.encoder)
         if profile != "jpeg":
@@ -683,28 +697,47 @@ class DataStreamingServer:
                 "tpu_mesh ignored for %s: watermark_path requires the solo "
                 "JPEG pipeline", st.display_id)
             return None
-        if self.mesh_coordinator is None:
+        geom = (st.width, st.height)
+        if geom in self._mesh_failed_geoms:
+            self.mesh_stats["solo_fallback"] += 1
+            return None
+        coord = self.mesh_coordinators.get(geom)
+        if coord is None:
+            if len(self.mesh_coordinators) >= 4:
+                # bounded bucket count: each bucket holds device prev
+                # planes for all its slots
+                self.mesh_stats["solo_fallback"] += 1
+                logger.warning(
+                    "mesh batching: bucket limit reached; %s at %dx%d "
+                    "uses a solo encoder", st.display_id, *geom)
+                return None
             try:
                 from ..parallel.coordinator import MeshEncodeCoordinator
 
-                self.mesh_coordinator = MeshEncodeCoordinator(
+                coord = MeshEncodeCoordinator(
                     spec, int(self.settings.tpu_sessions_per_chip),
                     st.width, st.height, settings=self.settings,
                     framerate=fps)
+                self.mesh_coordinators[geom] = coord
                 logger.info(
-                    "mesh batching: %s → %d session slots at %dx%d",
-                    spec, self.mesh_coordinator.n_sessions,
-                    st.width, st.height)
+                    "mesh batching: %s → %d session slots at %dx%d "
+                    "(bucket %d)", spec, coord.n_sessions, st.width,
+                    st.height, len(self.mesh_coordinators))
             except Exception:
                 logger.exception(
-                    "mesh coordinator unavailable; using solo encoders")
-                self._mesh_unavailable = True
+                    "mesh coordinator for %dx%d unavailable; that "
+                    "geometry uses solo encoders", *geom)
+                self._mesh_failed_geoms.add(geom)
+                self.mesh_stats["solo_fallback"] += 1
                 return None
-        facade = self.mesh_coordinator.acquire(st.width, st.height)
+        facade = coord.acquire(st.width, st.height)
         if facade is None:
+            self.mesh_stats["solo_fallback"] += 1
             logger.warning(
                 "mesh batching: no slot for %s at %dx%d; solo encoder",
                 st.display_id, st.width, st.height)
+        else:
+            self.mesh_stats["bucketed"] += 1
         return facade
 
     async def _backpressure_loop(self, st: DisplayState) -> None:
@@ -789,6 +822,12 @@ class DataStreamingServer:
                     "bytes_sent_delta": self.bytes_sent - prev_bytes,
                     "interval_s": STATS_INTERVAL_S,
                 }
+                if self.mesh_coordinators or self.mesh_stats["solo_fallback"]:
+                    # mesh fallbacks must be observable, not silent
+                    net["mesh_buckets"] = len(self.mesh_coordinators)
+                    net["mesh_sessions"] = self.mesh_stats["bucketed"]
+                    net["mesh_solo_fallbacks"] = \
+                        self.mesh_stats["solo_fallback"]
                 prev_bytes = self.bytes_sent
                 self.broadcast(json.dumps(net))
                 tpu = self._collect_tpu_stats()

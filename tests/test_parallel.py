@@ -177,3 +177,23 @@ def test_parse_mesh_spec():
         parse_mesh_spec("session:64", jax.devices()[:8])
     with pytest.raises(ValueError):
         parse_mesh_spec("tensor:2", jax.devices()[:8])
+
+
+def test_reset_session_zeroes_prev_planes(mesh):
+    """Slot recycling must not leak the previous occupant's pixels: the
+    prev planes and the idle-tick re-present buffer go to zero (VERDICT
+    r2 weak item 6)."""
+    import numpy as np
+    from selkies_tpu.parallel.mesh import MeshStripeEncoder
+
+    enc = MeshStripeEncoder(mesh, 4, 128, 128, stripe_h=64)
+    frames = [np.full((128, 128, 3), 200, np.uint8)] * 4
+    out, _ = enc.harvest(enc.dispatch(frames))
+    assert any(stripes for stripes in out)
+    assert np.asarray(enc._prev).any()
+    enc.reset_session(1)
+    prev = np.asarray(enc._prev)
+    assert not prev[1].any()           # recycled slot zeroed
+    assert prev[0].any()               # neighbours untouched
+    assert not enc._last_host[1].any()
+    assert enc._first[1]

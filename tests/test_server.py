@@ -594,3 +594,87 @@ async def test_mesh_batched_sessions_serve_wire_stripes(tmp_path):
         srv.close()
         assert server.mesh_coordinator is None or \
             not server.mesh_coordinator._thread
+
+
+@pytest.mark.anyio
+async def test_mesh_stripe_axis_single_session_config4(tmp_path):
+    """BASELINE config 4 as a product path: ONE display whose stripes
+    shard across the mesh's "stripe" axis (single-session shape, no
+    session batching) — the 4K-on-v5e-4 layout, scaled down to the CPU
+    test mesh. The display must ride the mesh coordinator, and the wire
+    stripes must decode."""
+    import io
+    from PIL import Image
+
+    server, app, encoders = make_server(
+        tmp_path,
+        SELKIES_TPU_MESH="stripe:4",
+        SELKIES_TPU_SESSIONS_PER_CHIP="1",
+    )
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await handshake(ws)
+            await ws.send('SETTINGS,' + json.dumps({
+                "displayId": "primary",
+                # 512 rows = 8 stripes of 64: divisible across stripe:4
+                "initialClientWidth": 320, "initialClientHeight": 512}))
+            got = []
+            while len(got) < 3:
+                m = await asyncio.wait_for(ws.recv(), 30)
+                if isinstance(m, bytes):
+                    f = unpack_binary(m)
+                    if isinstance(f, VideoStripe):
+                        got.append(f)
+            assert server.mesh_coordinator is not None
+            assert server.mesh_coordinator.n_sessions == 1
+            assert len(server.mesh_coordinator._attached) == 1
+            assert encoders == []      # solo factory never invoked
+        for f in got:
+            assert f.payload.startswith(b"\xff\xd8")
+            img = Image.open(io.BytesIO(f.payload))
+            assert img.size[0] == 320
+    finally:
+        await server.stop()
+        srv.close()
+
+
+@pytest.mark.anyio
+async def test_mesh_geometry_buckets(tmp_path):
+    """A join at a different resolution gets its own mesh bucket instead
+    of silently falling back to a solo encoder (VERDICT r2 item 6); the
+    fallback/bucket counters ride the stats feed."""
+    server, app, encoders = make_server(
+        tmp_path,
+        SELKIES_TPU_MESH="session:2",
+        SELKIES_TPU_SESSIONS_PER_CHIP="1",
+    )
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws1, \
+                websockets.connect(f"ws://127.0.0.1:{port}") as ws2:
+            await handshake(ws1)
+            await handshake(ws2)
+            await ws1.send('SETTINGS,' + json.dumps({
+                "displayId": "primary",
+                "initialClientWidth": 320, "initialClientHeight": 240}))
+            await ws2.send('SETTINGS,' + json.dumps({
+                "displayId": "display2",
+                "initialClientWidth": 256, "initialClientHeight": 128}))
+
+            async def first_stripe(ws):
+                while True:
+                    m = await asyncio.wait_for(ws.recv(), 30)
+                    if isinstance(m, bytes):
+                        f = unpack_binary(m)
+                        if isinstance(f, VideoStripe):
+                            return f
+            await first_stripe(ws1)
+            await first_stripe(ws2)
+            assert len(server.mesh_coordinators) == 2   # two buckets
+            assert server.mesh_stats["bucketed"] == 2
+            assert server.mesh_stats["solo_fallback"] == 0
+            assert encoders == []                        # no solo encoder
+    finally:
+        await server.stop()
+        srv.close()
