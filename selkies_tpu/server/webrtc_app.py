@@ -73,6 +73,9 @@ class WebRTCStreamingApp:
 
         self.pc: Optional[PeerConnection] = None
         self.signaling: Optional[SignalingClient] = None
+        #: fired when the input data channel opens (webrtc_main re-sends
+        #: the cached clipboard so pre-connect content isn't lost)
+        self.on_input_channel_open: Optional[Callable[[], None]] = None
         self.encoder = None
         self.source = None
         self.input_channel = None
@@ -132,6 +135,8 @@ class WebRTCStreamingApp:
         self.input_channel = self.pc.create_data_channel(
             "input", ordered=True, max_retransmits=0)
         self.input_channel.on_message = self._on_input_message
+        self.input_channel.on_open = lambda: (
+            self.on_input_channel_open and self.on_input_channel_open())
         self.pc.on_bitrate = self.set_video_bitrate
         self.pc.on_keyframe_request = self._on_keyframe_request
 

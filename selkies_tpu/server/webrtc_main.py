@@ -47,6 +47,40 @@ async def _amain(settings: Settings) -> int:
 
     app = WebRTCStreamingApp(settings, input_handler=input_handler)
 
+    if input_handler is not None:
+        # clipboard poll → JSON control object on the input data channel
+        # (the browser peer's webrtc.js onmessage handler; parity with
+        # the legacy send_clipboard helper, gstwebrtc_app.py:1371-1471)
+        import base64
+
+        last_clip = {"msg": None}
+
+        async def _clip_out(data: bytes, mime: str) -> None:
+            if mime != "text/plain":
+                # the WebRTC control channel carries text clipboard only
+                # for now; log instead of silently absorbing the read
+                # (the poll's dedup would otherwise suppress a re-copy)
+                logger.info("dropping non-text clipboard (%s, %d bytes) "
+                            "on the WebRTC control channel", mime,
+                            len(data))
+                return
+            msg = {"type": "clipboard",
+                   "data": base64.b64encode(data).decode()}
+            # cache: content read before the data channel opens (or
+            # between sessions) is re-sent on the next channel open
+            # instead of being lost to the poll's dedup
+            last_clip["msg"] = msg
+            app.send_json(msg)
+
+        def _on_input_open() -> None:
+            if last_clip["msg"] is not None:
+                app.send_json(last_clip["msg"])
+
+        input_handler.on_clipboard_read = _clip_out
+        app.on_input_channel_open = _on_input_open
+        tasks.append(asyncio.create_task(
+            input_handler.run_clipboard_poll()))
+
     if str(settings.turn_shared_secret) and str(settings.turn_host):
         monitor = HMACRTCMonitor(
             str(settings.turn_host), str(settings.turn_port),

@@ -184,6 +184,12 @@ class SctpAssociation:
     # ------------------------------------------------------------ control
 
     def start(self) -> None:
+        # receive() is live as soon as DTLS delivers app data, so on a
+        # fast path the peer's INIT/COOKIE exchange can complete before
+        # the owning transport gets here — start() must not regress an
+        # already-established association back to "connecting"
+        if self.state != "closed":
+            return
         self.state = "connecting"
         if self.is_client:
             self._send_init()

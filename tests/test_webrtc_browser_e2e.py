@@ -1,0 +1,302 @@
+"""Full-stack browser E2E: the SHIPPED webrtc.js against the REAL server.
+
+The strongest in-CI proof of the WebRTC product path: the actual
+web/webrtc.js logic executes under tools/minijs, its WebSocket is
+bridged to a live connection against the real SignalingServer, and its
+RTCPeerConnection is bridged to a real in-repo PeerConnection (the
+browser-engine stand-in, running real ICE/DTLS/SRTP/SCTP over
+loopback). The real WebRTCStreamingApp calls the browser peer exactly
+as `selkies-tpu-webrtc` does in production:
+
+  app(peer 0) ── SignalingServer ── webrtc.js(peer 1) ── PeerConnection
+
+and the test asserts H.264 media arrives, the input verbs typed through
+the JS client reach the server's input handler, and the clipboard
+control object round-trips. Reference counterpart:
+addons/gst-web/src/webrtc.js against legacy/signalling_web.py.
+
+Threading note: minijs's ``await`` settles promises by spinning the
+microtask queue synchronously, so the browser-side PeerConnection runs
+on a dedicated thread loop; bridge promises re-queue a sleeping
+microtask until the cross-thread future completes, and native → JS
+events are marshalled back through a queue drained on the main loop
+(the interpreter is not thread-safe).
+"""
+
+import asyncio
+import base64
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from web_stubs import BrowserEnv, install_webrtc_stubs  # noqa: E402
+from tools.minijs import (  # noqa: E402
+    JSArray, JSObject, JSPromise, NativeFunction, UNDEF, to_str)
+
+from selkies_tpu.rtc.signaling import SignalingServer  # noqa: E402
+from selkies_tpu.server.webrtc_app import WebRTCStreamingApp  # noqa: E402
+from selkies_tpu.webrtc.peerconnection import PeerConnection  # noqa: E402
+
+from test_webrtc_app import (  # noqa: E402
+    FakeEncoder, FakeSource, RecordingInput, Settings)
+
+
+class BridgePC:
+    """webrtc.js's RTCPeerConnection, backed by the real Python stack."""
+
+    def __init__(self, env, thread_loop):
+        self._env = env
+        self._tloop = thread_loop
+        self.pc = None
+        self.got_frames = []
+        self.events = []                  # thread-safe enough: append-only
+        self.connectionState = "new"
+        self.ontrack = None
+        self.ondatachannel = None
+        self.onicecandidate = None
+        self.onconnectionstatechange = None
+        self._track_fired = False
+
+        def build():
+            self.pc = PeerConnection(interfaces=["127.0.0.1"])
+            self.pc.video_receiver().on_frame = \
+                lambda f, ts: self._native_frame(f)
+            self.pc.on_channel = \
+                lambda ch: self.events.append(("channel", ch))
+        asyncio.run_coroutine_threadsafe(_acall(build), thread_loop).result()
+
+    # -- promise plumbing ---------------------------------------------
+
+    def _promise(self, coro):
+        """JSPromise settled from a cross-thread future; minijs awaits by
+        spinning microtasks, so a sleeping re-queueing task bridges."""
+        p = JSPromise(self._env.interp)
+        fut = asyncio.run_coroutine_threadsafe(coro, self._tloop)
+
+        def pump():
+            if fut.done():
+                try:
+                    value = fut.result()
+                except Exception as exc:
+                    p.reject(str(exc))
+                    return
+                p.resolve(UNDEF if value is None else value)
+            else:
+                time.sleep(0.005)
+                self._env.interp.microtasks.append(pump)
+
+        self._env.interp.microtasks.append(pump)
+        return p
+
+    # -- RTCPeerConnection surface ------------------------------------
+
+    def setRemoteDescription(self, desc):
+        sdp = to_str(self._env.get(desc, "sdp"))
+        return self._promise(self.pc.set_remote_description(sdp, "offer"))
+
+    def createAnswer(self):
+        async def go():
+            answer = await self.pc.create_answer()
+            return JSObject({"type": "answer", "sdp": answer})
+        return self._promise(go())
+
+    def setLocalDescription(self, desc):
+        return self._env.resolved(UNDEF)
+
+    def addIceCandidate(self, cand):
+        # the Python stack's SDP answers carry end-of-candidates
+        return self._env.resolved(UNDEF)
+
+    def close(self):
+        if self.pc is not None:
+            asyncio.run_coroutine_threadsafe(self.pc.close(), self._tloop)
+        self.connectionState = "closed"
+
+    # -- native-side events (thread-loop context) ---------------------
+
+    def _native_frame(self, frame):
+        self.got_frames.append(frame)
+        if not self._track_fired:
+            self._track_fired = True
+            self.events.append(("track", None))
+
+    # -- main-loop event dispatch into JS -----------------------------
+
+    def drain_events(self):
+        env = self._env
+        while self.events:
+            kind, payload = self.events.pop(0)
+            if kind == "track" and self.ontrack not in (None, UNDEF):
+                stream = JSObject({"id": "bridge-stream"})
+                env.call(self.ontrack, [JSObject(
+                    {"streams": JSArray([stream])})])
+            elif kind == "channel":
+                self._wire_channel(payload)
+            elif kind == "chmsg":
+                wrapper, data = payload
+                onmessage = wrapper.props.get("onmessage")
+                if onmessage not in (None, UNDEF):
+                    text = data.decode() if isinstance(data, bytes) \
+                        else str(data)
+                    env.call(onmessage, [JSObject({"data": text})])
+
+    def _wire_channel(self, ch):
+        env = self._env
+        wrapper = JSObject({"label": ch.label, "readyState": "open"})
+
+        def js_send(t, a, i):
+            text = to_str(a[0])
+            asyncio.run_coroutine_threadsafe(
+                _acall(lambda: self.pc.sctp.send(ch, text)), self._tloop)
+            return UNDEF
+
+        wrapper.props["send"] = NativeFunction(js_send, "send")
+        ch.on_message = lambda data: self.events.append(
+            ("chmsg", (wrapper, data)))
+        if self.ondatachannel not in (None, UNDEF):
+            env.call(self.ondatachannel, [JSObject({"channel": wrapper})])
+        onopen = wrapper.props.get("onopen")
+        if onopen not in (None, UNDEF):
+            env.call(onopen, [JSObject({})])
+
+
+async def _acall(fn):
+    return fn()
+
+
+def test_shipped_webrtc_js_full_session_against_real_server():
+    # the browser's WebRTC engine lives on its own thread loop
+    tloop = asyncio.new_event_loop()
+    tthread = threading.Thread(target=tloop.run_forever, daemon=True)
+    tthread.start()
+
+    async def run():
+        server = SignalingServer(addr="127.0.0.1", port=0)
+        stask = asyncio.create_task(server.run())
+        for _ in range(100):
+            if server.server is not None:
+                break
+            await asyncio.sleep(0.01)
+        uri = f"ws://127.0.0.1:{server.port}/ws"
+
+        env = BrowserEnv(files=())
+        install_webrtc_stubs(env)
+        bridges = []
+        env.interp.globals.vars["RTCPeerConnection"] = NativeFunction(
+            lambda t, a, i: bridges.append(BridgePC(env, tloop))
+            or bridges[-1], "RTCPeerConnection")
+        env.load("webrtc.js")
+
+        statuses = []
+        clips = []
+        video = env.document.createElement("video")
+        client = env.construct(env.exports["SelkiesWebRTCClient"], [
+            JSObject({
+                "signalingUrl": uri,
+                "video": video,
+                "rtcConfig": JSObject({}),   # skip the /turn fetch
+                "onStatus": NativeFunction(
+                    lambda t, a, i: (statuses.append(to_str(a[0])),
+                                     UNDEF)[1]),
+                "onClipboard": NativeFunction(
+                    lambda t, a, i: (clips.append(to_str(a[0])),
+                                     UNDEF)[1]),
+            })])
+        env.call(env.get(client, "connect"), [], this=client)
+        fake_ws = env.sockets[-1]
+
+        import websockets
+        real_ws = await websockets.connect(uri)
+        fake_ws.server_open()                 # JS sends HELLO 1 <meta>
+        sent_idx = 0
+
+        async def pump_out():
+            nonlocal sent_idx
+            while True:
+                while sent_idx < len(fake_ws.sent):
+                    await real_ws.send(fake_ws.sent[sent_idx])
+                    sent_idx += 1
+                for b in bridges:
+                    b.drain_events()
+                await asyncio.sleep(0.005)
+
+        async def pump_in():
+            async for msg in real_ws:
+                if isinstance(msg, str):
+                    fake_ws.server_text(msg)
+
+        pumps = [asyncio.create_task(pump_out()),
+                 asyncio.create_task(pump_in())]
+
+        recorder = RecordingInput()
+        app = WebRTCStreamingApp(
+            Settings(),
+            encoder_factory=lambda w, h: FakeEncoder(),
+            source_factory=lambda w, h, fps: FakeSource(w, h, fps),
+            input_handler=recorder,
+            interfaces=["127.0.0.1"])
+        atask = asyncio.create_task(app.run(uri, "0", "1"))
+
+        try:
+            for _ in range(600):
+                if "negotiated" in statuses:
+                    break
+                await asyncio.sleep(0.05)
+            assert "negotiated" in statuses, statuses
+            assert bridges, "RTCPeerConnection never constructed"
+            bridge = bridges[0]
+
+            # media arrives through the real ICE/DTLS/SRTP path
+            for _ in range(600):
+                if len(bridge.got_frames) >= 3:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(bridge.got_frames) >= 3, "no video frames"
+            assert bridge.got_frames[0].startswith(b"\x00\x00\x00\x01\x67")
+            assert env.get(video, "srcObject") is not UNDEF
+
+            # input channel: JS-side send() verbs reach the server's
+            # input handler through the real data channel
+            for _ in range(200):
+                if "input-ready" in statuses:
+                    break
+                await asyncio.sleep(0.05)
+            assert "input-ready" in statuses, statuses
+            env.call(env.get(client, "send"), ["kd,65"], this=client)
+            env.call(env.get(client, "send"), ["m,10,20,0,0"],
+                     this=client)
+            for _ in range(200):
+                if len(recorder.messages) >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert recorder.messages[:2] == ["kd,65", "m,10,20,0,0"]
+
+            # clipboard control object → JS onClipboard
+            app.send_json({"type": "clipboard",
+                           "data": base64.b64encode(b"hi").decode()})
+            for _ in range(200):
+                if clips:
+                    break
+                await asyncio.sleep(0.05)
+            assert clips == ["hi"]
+        finally:
+            await app.stop_pipeline()
+            if bridges:
+                bridges[0].close()
+            for t in pumps + [atask, stask]:
+                t.cancel()
+            await real_ws.close()
+            await server.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        tloop.call_soon_threadsafe(tloop.stop)
+        tthread.join(timeout=5)

@@ -456,3 +456,23 @@ def test_sack_rwnd_discounts_flight():
     a.receive(sack)                          # SACK covers only chunk 1
     assert a.flight < in_flight_before
     assert a.peer_rwnd <= max(0, b.a_rwnd - a.flight)
+
+
+def test_start_does_not_regress_established_association():
+    """On fast transports the whole INIT/COOKIE handshake can finish
+    (driven by receive()) before the owning transport calls start();
+    start() must not clobber the established state — the regression left
+    the data channel permanently unopened while media flowed."""
+    a, b, qa, qb = make_pair()
+    ch = a.create_channel("input")
+    a.start()                       # client sends INIT
+    # server side never called start() yet; drive the full handshake
+    pump(a, b, qa, qb)
+    assert b.state == "established"
+    b.start()                       # late start must be a no-op
+    assert b.state == "established"
+    got = []
+    b.channels[ch.stream_id].on_message = got.append
+    a.send(ch, "kd,65")
+    pump(a, b, qa, qb)
+    assert got == [b"kd,65"]

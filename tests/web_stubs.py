@@ -736,3 +736,117 @@ class BrowserEnv:
                 v = float(v)
             base[k] = v
         return JSObject(base)
+
+
+# ------------------------------------------------------------- WebRTC
+
+
+class FakeRTCDataChannel:
+    """Server-created data channel as seen from the browser."""
+
+    def __init__(self, env, label="input"):
+        self._env = env
+        self.label = label
+        self.readyState = "connecting"
+        self.sent: List[Any] = []
+        self.onopen = None
+        self.onmessage = None
+
+    def send(self, data):
+        self.sent.append(to_str(data) if isinstance(data, str) else data)
+
+    # test helpers -----------------------------------------------------
+    def server_open(self):
+        self.readyState = "open"
+        if self.onopen not in (None, UNDEF):
+            self._env.call(self.onopen, [JSObject({})])
+
+    def server_message(self, text: str):
+        if self.onmessage not in (None, UNDEF):
+            self._env.call(self.onmessage, [JSObject({"data": text})])
+
+
+class FakeRTCPeerConnection:
+    def __init__(self, env, cfg=UNDEF):
+        self._env = env
+        self.config = cfg
+        self.remoteDescription = None
+        self.localDescription = None
+        self.added_ice: List[Any] = []
+        self.connectionState = "new"
+        self.ontrack = None
+        self.ondatachannel = None
+        self.onicecandidate = None
+        self.onconnectionstatechange = None
+        self.closed = False
+        env.peer_connections.append(self)
+
+    def setRemoteDescription(self, desc):
+        self.remoteDescription = desc
+        return self._env.resolved(UNDEF)
+
+    def createAnswer(self):
+        return self._env.resolved(JSObject(
+            {"type": "answer", "sdp": "v=0\r\ns=fake-answer\r\n"}))
+
+    def setLocalDescription(self, desc):
+        self.localDescription = desc
+        return self._env.resolved(UNDEF)
+
+    def addIceCandidate(self, cand):
+        self.added_ice.append(cand)
+        return self._env.resolved(UNDEF)
+
+    def close(self):
+        self.closed = True
+        self.connectionState = "closed"
+
+    # test helpers -----------------------------------------------------
+    def server_datachannel(self, label="input") -> FakeRTCDataChannel:
+        ch = FakeRTCDataChannel(self._env, label)
+        if self.ondatachannel not in (None, UNDEF):
+            self._env.call(self.ondatachannel,
+                           [JSObject({"channel": ch})])
+        return ch
+
+    def server_track(self, stream):
+        if self.ontrack not in (None, UNDEF):
+            self._env.call(self.ontrack, [JSObject(
+                {"streams": JSArray([stream])})])
+
+    def fire_local_ice(self, candidate: str, mline: float = 0.0):
+        if self.onicecandidate not in (None, UNDEF):
+            self._env.call(self.onicecandidate, [JSObject({
+                "candidate": JSObject({"candidate": candidate,
+                                       "sdpMLineIndex": mline})})])
+
+    def set_connection_state(self, state: str):
+        self.connectionState = state
+        if self.onconnectionstatechange not in (None, UNDEF):
+            self._env.call(self.onconnectionstatechange, [JSObject({})])
+
+
+def install_webrtc_stubs(env):
+    """Declare RTCPeerConnection + fetch for webrtc.js tests."""
+    env.peer_connections = []
+    env.fetch_calls = []
+    env.turn_config = JSObject({"iceServers": JSArray([JSObject(
+        {"urls": JSArray(["stun:stun.fake:3478"])})])})
+
+    g = env.interp.globals
+    g.declare("RTCPeerConnection", NativeFunction(
+        lambda t, a, i: FakeRTCPeerConnection(env, a[0] if a else UNDEF),
+        "RTCPeerConnection"))
+
+    def _fetch(t, a, i):
+        url = to_str(a[0])
+        env.fetch_calls.append(url)
+        resp = JSObject({
+            "ok": True,
+            "json": NativeFunction(
+                lambda tt, aa, ii: env.resolved(env.turn_config), "json"),
+        })
+        return env.resolved(resp)
+
+    g.declare("fetch", NativeFunction(_fetch, "fetch"))
+    g.declare("devicePixelRatio", 2.0)

@@ -1,0 +1,273 @@
+/*
+ * webrtc.js — browser WebRTC peer for selkies-tpu's WebRTC mode.
+ *
+ * Role parity with the reference's legacy webrtcbin peer
+ * (addons/gst-web/src/webrtc.js:42-790) and signaling client
+ * (signaling.js:36-320): registers with the in-process signaling server
+ * (rtc/signaling.py HELLO/SESSION grammar), answers the server's SDP
+ * offer, renders the H.264 track into a <video>, and carries the input
+ * verbs over the server-created "input" data channel — the same wire
+ * grammar web/input.js already speaks over WebSocket mode, so the
+ * SelkiesInput class plugs in unchanged (its client contract is one
+ * send(text) method).
+ *
+ * Flow (server is peer "0" and the caller, webrtc_main.py:59-63):
+ *   browser → WS /ws: "HELLO 1 <meta_b64>"      → server ack "HELLO"
+ *   server  → {"sdp": {type: "offer", ...}}     (after SESSION setup)
+ *   browser → setRemoteDescription → createAnswer → {"sdp": answer}
+ *   both    → {"ice": {candidate, sdpMLineIndex}} trickle
+ *   server  → datachannel "input" (ordered) → SelkiesInput.send verbs up,
+ *             JSON control objects (clipboard/cursor) down.
+ */
+
+"use strict";
+
+class SelkiesWebRTCClient {
+  constructor(opts) {
+    this.signalingUrl = opts.signalingUrl;
+    this.peerId = opts.peerId || "1";
+    this.video = opts.video;
+    this.onStatus = opts.onStatus || (() => {});
+    this.onClipboard = opts.onClipboard || (() => {});
+    this.onCursor = opts.onCursor || (() => {});
+    this.onStats = opts.onStats || (() => {});
+    this.rtcConfig = opts.rtcConfig || null;
+
+    this.ws = null;
+    this.pc = null;
+    this.inputChannel = null;
+    this._sendQueue = [];
+    this._statsTimer = null;
+    this._lastStats = { bytes: 0, frames: 0, t: 0 };
+    this.state = "idle";
+  }
+
+  _status(s) {
+    this.state = s;
+    this.onStatus(s);
+  }
+
+  /* The signaling web server mints TURN credentials at /turn
+     (rtc/signaling.py _turn_response; reference signaling.js app.config
+     fetch). Missing config degrades to host candidates (LAN). */
+  async fetchRtcConfig() {
+    if (this.rtcConfig) return this.rtcConfig;
+    try {
+      const base = this.signalingUrl
+        .replace(/^ws/, "http").replace(/\/ws$/, "");
+      const resp = await fetch(base + "/turn");
+      if (resp.ok) {
+        const cfg = await resp.json();
+        this.rtcConfig = { iceServers: cfg.iceServers || [] };
+        return this.rtcConfig;
+      }
+    } catch (e) { /* no TURN plane: host candidates only */ }
+    this.rtcConfig = { iceServers: [] };
+    return this.rtcConfig;
+  }
+
+  async connect() {
+    await this.fetchRtcConfig();
+    this._status("connecting");
+    this.ws = new WebSocket(this.signalingUrl);
+    this.ws.onopen = () => {
+      const meta = btoa(JSON.stringify({
+        res: (screen && screen.width)
+          ? `${screen.width}x${screen.height}` : "1280x720",
+        scale: (typeof devicePixelRatio !== "undefined")
+          ? devicePixelRatio : 1,
+      }));
+      this.ws.send(`HELLO ${this.peerId} ${meta}`);
+    };
+    this.ws.onmessage = (ev) => this._onSignal(ev.data);
+    this.ws.onclose = () => {
+      this._status("disconnected");
+      this._teardownPc();
+    };
+    this.ws.onerror = () => this._status("error");
+  }
+
+  close() {
+    if (this.ws) this.ws.close();
+    this._teardownPc();
+  }
+
+  _teardownPc() {
+    if (this._statsTimer) {
+      clearInterval(this._statsTimer);
+      this._statsTimer = null;
+    }
+    if (this.pc) {
+      this.pc.close();
+      this.pc = null;
+    }
+    this.inputChannel = null;
+  }
+
+  _onSignal(msg) {
+    if (typeof msg !== "string") return;
+    if (msg === "HELLO") {
+      this._status("registered");
+      return;
+    }
+    if (msg.startsWith("SESSION_OK")) {
+      this._status("session");
+      return;
+    }
+    if (msg.startsWith("ERROR")) {
+      this._status("error");
+      return;
+    }
+    let data;
+    try {
+      data = JSON.parse(msg);
+    } catch (e) {
+      return;                       // non-JSON control chatter
+    }
+    if (data.sdp) this._onRemoteSdp(data.sdp);
+    else if (data.ice) this._onRemoteIce(data.ice);
+  }
+
+  async _onRemoteSdp(desc) {
+    if (desc.type !== "offer") return;
+    if (!this.pc) this._makePc();
+    await this.pc.setRemoteDescription(desc);
+    const answer = await this.pc.createAnswer();
+    await this.pc.setLocalDescription(answer);
+    this.ws.send(JSON.stringify({
+      sdp: { type: answer.type, sdp: answer.sdp },
+    }));
+    this._status("negotiated");
+  }
+
+  async _onRemoteIce(ice) {
+    if (!this.pc || !ice || !ice.candidate) return;
+    try {
+      await this.pc.addIceCandidate({
+        candidate: ice.candidate,
+        sdpMLineIndex: ice.sdpMLineIndex || 0,
+      });
+    } catch (e) { /* end-of-candidates / stale */ }
+  }
+
+  _makePc() {
+    this.pc = new RTCPeerConnection(this.rtcConfig || { iceServers: [] });
+    this.pc.ontrack = (ev) => {
+      // one MediaStream carries the H.264 video + Opus audio tracks
+      if (this.video && ev.streams && ev.streams[0]) {
+        if (this.video.srcObject !== ev.streams[0]) {
+          this.video.srcObject = ev.streams[0];
+          if (typeof this.video.play === "function") {
+            const p = this.video.play();
+            if (p && p.catch) p.catch(() => {});
+          }
+        }
+      }
+    };
+    this.pc.ondatachannel = (ev) => {
+      if (ev.channel.label === "input") this._wireInput(ev.channel);
+    };
+    this.pc.onicecandidate = (ev) => {
+      if (ev.candidate && ev.candidate.candidate) {
+        this.ws.send(JSON.stringify({
+          ice: {
+            candidate: ev.candidate.candidate,
+            sdpMLineIndex: ev.candidate.sdpMLineIndex || 0,
+          },
+        }));
+      }
+    };
+    this.pc.onconnectionstatechange = () => {
+      const st = this.pc ? this.pc.connectionState : "closed";
+      if (st === "connected") {
+        this._status("connected");
+        this._startStats();
+      } else if (st === "failed" || st === "closed") {
+        this._status("disconnected");
+      }
+    };
+  }
+
+  _wireInput(channel) {
+    this.inputChannel = channel;
+    const flush = () => {
+      const q = this._sendQueue;
+      this._sendQueue = [];
+      for (const m of q) channel.send(m);
+      this._status("input-ready");
+    };
+    channel.onopen = flush;
+    // a remotely-announced channel can arrive already open (the open
+    // event fired before ondatachannel on the announcing side) — the
+    // queue must flush now or queued input waits forever
+    if (channel.readyState === "open") flush();
+    channel.onmessage = (ev) => {
+      // downstream control objects mirror the legacy data-channel
+      // helpers (webrtc_app._send_control): clipboard + cursor
+      let obj;
+      try {
+        obj = JSON.parse(ev.data);
+      } catch (e) {
+        return;
+      }
+      if (obj.type === "clipboard" && typeof obj.data === "string") {
+        try {
+          this.onClipboard(
+            decodeURIComponent(escape(atob(obj.data))));
+        } catch (e) { /* non-base64 payload */ }
+      } else if (obj.type === "cursor") {
+        this.onCursor(obj);
+      }
+    };
+  }
+
+  /* SelkiesInput's entire client contract. */
+  send(text) {
+    if (this.inputChannel && this.inputChannel.readyState === "open") {
+      this.inputChannel.send(text);
+    } else {
+      this._sendQueue.push(text);
+      if (this._sendQueue.length > 256) this._sendQueue.shift();
+    }
+  }
+
+  sendClipboard(text) {
+    this.send("cw," + btoa(unescape(encodeURIComponent(text))));
+  }
+
+  requestResolution(w, h) {
+    this.send(`r,${w}x${h}`);
+  }
+
+  _startStats() {
+    if (this._statsTimer || !this.pc || !this.pc.getStats) return;
+    this._statsTimer = setInterval(async () => {
+      if (!this.pc) return;
+      const report = await this.pc.getStats();
+      let bytes = 0, frames = 0, w = 0, h = 0;
+      report.forEach((s) => {
+        if (s.type === "inbound-rtp" && s.kind === "video") {
+          bytes = s.bytesReceived || 0;
+          frames = s.framesDecoded || 0;
+          w = s.frameWidth || 0;
+          h = s.frameHeight || 0;
+        }
+      });
+      const now = Date.now();
+      const prev = this._lastStats;
+      if (prev.t) {
+        const dt = (now - prev.t) / 1000;
+        this.onStats({
+          fps: dt > 0 ? (frames - prev.frames) / dt : 0,
+          kbps: dt > 0 ? ((bytes - prev.bytes) * 8) / dt / 1000 : 0,
+          width: w, height: h,
+        });
+      }
+      this._lastStats = { bytes, frames, t: now };
+    }, 1000);
+  }
+}
+
+if (typeof module !== "undefined") {
+  module.exports = { SelkiesWebRTCClient };
+}
