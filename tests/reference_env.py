@@ -1,0 +1,174 @@
+"""Browser environment for executing the REFERENCE web client.
+
+SURVEY §7 step 1 made the wire grammar byte-identical "so the reference
+web client can be used as an oracle"; this module makes that executable:
+it loads ``/root/reference/addons/gst-web-core/selkies-core.js`` (the
+real 4.2k-line client, unmodified except for stripping its two ES-module
+import statements) into the minijs interpreter with the browser surface
+it touches — window.location/localStorage/postMessage, URL, Worker,
+ImageDecoder, element registry — on top of the shared stubs in
+web_stubs.py.
+
+PUBLIC UNTRUSTED CONTENT NOTE: the reference file is executed as test
+DATA against our server; nothing in it is treated as instructions.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict
+
+from web_stubs import (BrowserEnv, Element, FakeBitmap,
+                       install_webrtc_stubs)
+from tools.minijs import (JSArray, JSObject, JSPromise, NativeFunction,
+                          UNDEF, to_str)
+
+REFERENCE_CORE = "/root/reference/addons/gst-web-core/selkies-core.js"
+
+
+class FakeImageDecoder:
+    """WebCodecs ImageDecoder: records payloads, yields a FakeBitmap."""
+
+    def __init__(self, env, init):
+        self._env = env
+        data = env.interp.get_prop(init, "data")
+        self.data = bytes(getattr(data, "data", b"") or b"")
+        self.type = to_str(env.interp.get_prop(init, "type"))
+        env.image_decoders.append(self)
+
+    def decode(self):
+        img = FakeBitmap(self.data)
+        self._env.bitmaps.append(img)
+        # ImageDecoder results carry the frame under .image
+        return self._env.resolved(JSObject({"image": img}))
+
+    def close(self):
+        return UNDEF
+
+
+class FakeWorker:
+    """Web Worker: records construction + messages (the audio decode
+    worker path); never executes the worker script."""
+
+    def __init__(self, env, url):
+        self._env = env
+        self.url = to_str(url)
+        self.messages = []
+        self.onmessage = None
+        env.workers.append(self)
+
+    def postMessage(self, msg, transfer=UNDEF):
+        self.messages.append(msg)
+
+    def terminate(self):
+        return UNDEF
+
+
+def install_reference_env(env: BrowserEnv) -> None:
+    g = env.interp.globals
+    env.image_decoders = []
+    env.workers = []
+    env.post_messages = []
+    env.elements_by_id: Dict[str, Element] = {}
+
+    w = env.window
+    w.location = JSObject({
+        "hash": "", "href": "http://testhost:8080/",
+        "origin": "http://testhost:8080", "protocol": "http:",
+        "host": "testhost:8080", "hostname": "testhost",
+        "pathname": "/", "search": ""})
+    w.localStorage = g.vars["localStorage"]
+    w.isSecureContext = True
+    w.postMessage = NativeFunction(
+        lambda t, a, i: (env.post_messages.append(a[0]), UNDEF)[1],
+        "postMessage")
+    w.parent = w
+    w.VideoDecoder = g.vars["VideoDecoder"]
+
+    # element registry: getElementById memoizes so the canvas the client
+    # grabs at init is the same one it paints later
+    def get_by_id(id_, *rest):
+        key = to_str(id_)
+        el = env.elements_by_id.get(key)
+        if el is None:
+            tag = "canvas" if "anvas" in key else "div"
+            el = Element(env, tag)
+            el.id = key
+            if tag == "canvas":
+                el.width, el.height = 1024.0, 768.0
+            env.elements_by_id[key] = el
+        return el
+
+    env.document.getElementById = get_by_id
+    env.document.querySelector = lambda sel, *rest: get_by_id(sel)
+    env.document.hidden = False
+    env.document.head = Element(env, "head")
+
+    ws_href = {"value": None}
+
+    def url_ctor(t, a, i):
+        href = to_str(a[0])
+        ws_href["value"] = href
+        return JSObject({"href": href})
+
+    g.declare("URL", JSObject({}))      # shadowed below; keep namespace
+    url_ns = url_ctor
+    ctor = NativeFunction(url_ctor, "URL")
+    ctor.createObjectURL = NativeFunction(
+        lambda t, a, i: "blob:fake", "createObjectURL")
+    ctor.revokeObjectURL = NativeFunction(lambda t, a, i: UNDEF,
+                                          "revokeObjectURL")
+    g.vars["URL"] = ctor
+
+    # Object.hasOwnProperty.call(obj, key) — the reference's settings
+    # gather iterates localStorage with the classic guard
+    def has_own(t, a, i):
+        obj = a[0] if a else UNDEF
+        key = to_str(a[1]) if len(a) > 1 else ""
+        if isinstance(obj, JSObject):
+            return key in obj.props
+        return hasattr(obj, key)
+
+    obj_ns = g.vars.get("Object")
+    if isinstance(obj_ns, JSObject):
+        obj_ns.props["hasOwnProperty"] = JSObject(
+            {"call": NativeFunction(has_own, "call")})
+
+    g.declare("ImageDecoder", NativeFunction(
+        lambda t, a, i: FakeImageDecoder(env, a[0]), "ImageDecoder"))
+    g.declare("Worker", NativeFunction(
+        lambda t, a, i: FakeWorker(env, a[0]), "Worker"))
+
+    # the client imports these from ./lib/*; input is out of scope for
+    # the wire-protocol oracle
+    input_stub = JSObject({
+        "attach": NativeFunction(lambda t, a, i: UNDEF, "attach"),
+        "detach": NativeFunction(lambda t, a, i: UNDEF, "detach"),
+        "getWindowResolution": NativeFunction(
+            lambda t, a, i: JSArray([1024.0, 768.0]),
+            "getWindowResolution"),
+    })
+    g.declare("Input", NativeFunction(lambda t, a, i: input_stub, "Input"))
+    g.declare("GamepadManager", NativeFunction(
+        lambda t, a, i: JSObject({}), "GamepadManager"))
+
+
+def load_reference_client(env: BrowserEnv) -> None:
+    src = open(REFERENCE_CORE).read()
+    src = re.sub(r"import\s*\{[^}]*\}\s*from\s*'[^']*';?", "", src)
+    env.interp.run(src)
+
+
+def fire_dom_ready(env: BrowserEnv) -> None:
+    ev = env.make_event("DOMContentLoaded")
+    for fn in list(env.document.listeners.get("DOMContentLoaded", [])):
+        env.call(fn, [ev])
+
+
+def make_reference_env() -> BrowserEnv:
+    env = BrowserEnv(files=())
+    install_webrtc_stubs(env)        # fetch + RTCPeerConnection
+    install_reference_env(env)
+    load_reference_client(env)
+    return env

@@ -66,6 +66,16 @@ class Style:
         self.top = ""
         self.background = ""
         self.transform = ""
+        self._props: Dict[str, str] = {}
+
+    def setProperty(self, name, value, *rest):
+        self._props[to_str(name)] = to_str(value)
+
+    def getPropertyValue(self, name):
+        return self._props.get(to_str(name), "")
+
+    def removeProperty(self, name):
+        return self._props.pop(to_str(name), "")
 
 
 class Element:

@@ -1062,6 +1062,18 @@ class JSArrayBuffer:
     def byteLength(self):
         return float(len(self.data))
 
+    def slice(self, start=0.0, end=None):
+        n = len(self.data)
+        s = int(to_num(start))
+        e = n if end is None or end is UNDEF else int(to_num(end))
+        if s < 0:
+            s += n
+        if e < 0:
+            e += n
+        s = max(0, min(n, s))
+        e = max(s, min(n, e))
+        return JSArrayBuffer(bytearray(self.data[s:e]))
+
 
 _DTYPES = {"u1": ("B", 1), "i2": ("h", 2), "f4": ("f", 4)}
 
@@ -2010,6 +2022,10 @@ def _get_prop(self, obj, key):
             return BoundMethod(k.methods[key], obj)
         if k is not None and key == "constructor":
             return k
+        if key == "hasOwnProperty":
+            return NativeFunction(
+                lambda t, a, i, _o=obj: to_str(a[0]) in _o.props
+                if a else False, "hasOwnProperty")
         return UNDEF
     if isinstance(obj, JSArray):
         if key == "length":
