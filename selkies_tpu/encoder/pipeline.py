@@ -21,6 +21,7 @@ are the device stream plus async host copies.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, List, Optional, Tuple
@@ -379,7 +380,8 @@ class PipelinedH264Encoder:
     """
 
     def __init__(self, base, depth: int = 8, fetch_group: int = 4,
-                 batch: int = 1) -> None:
+                 batch: int = 1,
+                 batch_deadline_s: Optional[float] = None) -> None:
         self.base = base
         self.depth = depth
         self.fetch_group = max(1, fetch_group)
@@ -387,6 +389,16 @@ class PipelinedH264Encoder:
         #: — RPC-attached transports pay per dispatch, so batch>1 divides
         #: that cost; PCIe deployments keep 1 (no added latency)
         self.batch = max(1, batch)
+        #: oldest-buffered-frame age at which poll(flush_partial=False)
+        #: dispatches a partial batch anyway — a caller that pauses
+        #: submission must not strand tail frames indefinitely. The
+        #: default scales with batch so a batch can actually FILL at
+        #: realistic frame rates (2.5 frame-times per slot at 60 fps)
+        #: before the deadline degrades it to single-frame dispatches.
+        if batch_deadline_s is None:
+            batch_deadline_s = max(0.05, 2.5 * self.batch / 60.0)
+        self.batch_deadline_s = batch_deadline_s
+        self._batch_t0 = 0.0
         self._batch_frames: List[Any] = []
         self._inflight: deque[_H264InFlight] = deque()
         self._unfetched: List[_H264InFlight] = []
@@ -423,6 +435,8 @@ class PipelinedH264Encoder:
             self._ready.append(self._drain_one())
         if self.batch > 1:
             seq = self._seq + len(self._batch_frames)
+            if not self._batch_frames:
+                self._batch_t0 = time.monotonic()
             self._batch_frames.append(frame)
             if len(self._batch_frames) >= self.batch:
                 self._flush_batch()
@@ -564,7 +578,11 @@ class PipelinedH264Encoder:
         """Harvest completed frames in order; see PipelinedJpegEncoder.poll
         for the ``flush_partial`` latency/throughput trade."""
         out, self._ready = self._ready, []
-        if flush_partial and self._batch_frames:
+        if self._batch_frames and (
+                flush_partial
+                or time.monotonic() - self._batch_t0 > self.batch_deadline_s):
+            # deadline flush: frames buffered toward a batch must not wait
+            # forever when the caller pauses submission
             self._flush_batch()
         if self._unfetched and flush_partial:
             self._issue_fetch()

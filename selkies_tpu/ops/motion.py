@@ -190,6 +190,10 @@ def full_search_mc(cur, ref, ref_cb, ref_cr, *, mb: int = 16,
 
     Returns (mv, pred_y u8, pred_cb u8, pred_cr u8).
     """
+    if chunk > 256:
+        # c_arg below is uint8; a larger chunk would silently wrap the
+        # within-chunk argmin and select wrong predictions
+        raise ValueError(f"chunk must be <= 256, got {chunk}")
     h, w = cur.shape[-2:]
     hc, wc = ref_cb.shape[-2:]
     cb2 = mb // 2

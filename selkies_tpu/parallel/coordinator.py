@@ -118,6 +118,12 @@ class MeshEncodeCoordinator:
 
     # -- session lifecycle (event-loop side) -------------------------------
 
+    @property
+    def active_sessions(self) -> int:
+        """Currently attached sessions (live occupancy, not cumulative)."""
+        with self._lock:
+            return len(self._attached)
+
     def acquire(self, width: int, height: int) -> Optional[MeshSessionFacade]:
         """Attach a session; None when geometry differs or slots are full."""
         if (width, height) != (self.width, self.height):

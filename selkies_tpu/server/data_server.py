@@ -823,9 +823,16 @@ class DataStreamingServer:
                     "interval_s": STATS_INTERVAL_S,
                 }
                 if self.mesh_coordinators or self.mesh_stats["solo_fallback"]:
-                    # mesh fallbacks must be observable, not silent
+                    # mesh fallbacks must be observable, not silent.
+                    # "bucketed" is a cumulative acquisition counter (it
+                    # never decrements on release), so surface it under a
+                    # _total name and report live occupancy separately.
                     net["mesh_buckets"] = len(self.mesh_coordinators)
-                    net["mesh_sessions"] = self.mesh_stats["bucketed"]
+                    net["mesh_acquisitions_total"] = \
+                        self.mesh_stats["bucketed"]
+                    net["mesh_sessions"] = sum(
+                        coord.active_sessions
+                        for coord in self.mesh_coordinators.values())
                     net["mesh_solo_fallbacks"] = \
                         self.mesh_stats["solo_fallback"]
                 prev_bytes = self.bytes_sent

@@ -44,6 +44,29 @@ class BoolValue:
         return self.value
 
 
+class EnumValue(str):
+    """An enum setting value that may carry a *restricted* allowed list.
+
+    Mirrors the reference's enum-override semantics
+    (/root/reference/src/selkies/settings.py:29-31): overriding an enum with
+    ``SELKIES_ENCODER="jpeg,x264enc"`` makes the first item the default and
+    the full list the allowed options; a single value locks the choice.
+    Subclasses ``str`` so consumers keep using it as the plain value.
+    """
+
+    allowed: Tuple[str, ...] = ()
+
+    def __new__(cls, value: str, allowed: Sequence[str] = ()):
+        self = super().__new__(cls, value)
+        # frozen-style: set via object.__setattr__ for clarity of intent
+        object.__setattr__(self, "allowed", tuple(allowed))
+        return self
+
+    @property
+    def locked(self) -> bool:
+        return len(self.allowed) == 1
+
+
 @dataclass(frozen=True)
 class RangeValue:
     """An allowed [lo, hi] range plus the default the client starts at.
@@ -131,13 +154,20 @@ class EnumSpec(Spec):
     allowed: Tuple[str, ...] = ()
     kind: str = field(default="enum", init=False)
 
-    def parse(self, raw: str) -> str:
-        v = raw.strip()
-        if v not in self.allowed:
+    def parse(self, raw: str) -> EnumValue:
+        """A comma list restricts the allowed options (first item becomes
+        the default); a single value locks the choice — the reference's
+        documented override semantics (settings.py:29-31)."""
+        items = tuple(p.strip() for p in raw.split(",") if p.strip())
+        bad = [p for p in items if p not in self.allowed]
+        if not items or bad:
             raise ValueError(
-                f"{self.name}: {v!r} not in allowed set {list(self.allowed)}"
-            )
-        return v
+                f"{self.name}: {bad or raw!r} not in allowed set "
+                f"{list(self.allowed)}")
+        return EnumValue(items[0], items)
+
+    def normalize_default(self) -> EnumValue:
+        return EnumValue(str(self.default), self.allowed)
 
 
 @dataclass(frozen=True)
@@ -378,7 +408,11 @@ class Settings:
             elif isinstance(spec, RangeSpec):
                 entry = {"value": v.default, "min": v.lo, "max": v.hi,
                          "default": v.default}
-            elif isinstance(spec, (EnumSpec, ListSpec)):
+            elif isinstance(spec, EnumSpec):
+                allowed = v.allowed if isinstance(v, EnumValue) and v.allowed \
+                    else spec.allowed
+                entry = {"value": str(v), "allowed": list(allowed)}
+            elif isinstance(spec, ListSpec):
                 entry = {"value": list(v) if isinstance(v, tuple) else v,
                          "allowed": list(spec.allowed)}
             else:
@@ -408,7 +442,9 @@ class Settings:
                 return value.strip().lower() in ("true", "1", "yes", "on")
             return bool(value)
         if isinstance(spec, EnumSpec):
-            return value if value in spec.allowed else (
+            allowed = current.allowed if isinstance(current, EnumValue) \
+                and current.allowed else spec.allowed
+            return value if value in allowed else (
                 current if isinstance(current, str) else spec.normalize_default())
         if isinstance(spec, ListSpec):
             items = value if isinstance(value, (list, tuple)) else str(value).split(",")

@@ -366,6 +366,15 @@ class PeerConnection:
                     baseline = "profile-level-id=42" in fmtp
                     return (mode1, baseline)
                 matches.sort(key=rank, reverse=True)
+            if (codec_name == "h264" and matches
+                    and "packetization-mode=1" not in (matches[0].fmtp or "")):
+                # we still emit FU-A at this PT; a strict single-NAL
+                # (mode-0) receiver cannot parse fragmented units
+                logger.warning(
+                    "remote offers no packetization-mode=1 H264 entry "
+                    "(using pt=%d); FU-A fragments may not decode on "
+                    "a strict mode-0 receiver",
+                    matches[0].payload_type)
             pt = matches[0].payload_type if matches else None
             if pt is None or pt == current:
                 return current

@@ -73,11 +73,23 @@ def install_reference_env(env: BrowserEnv) -> None:
     env.elements_by_id: Dict[str, Element] = {}
 
     w = env.window
+    # location.reload(): the reference client reloads once on first visit
+    # (its sanitize pass flags every unset bool as a change, and the
+    # `debug` change handler schedules a reload, selkies-core.js:1933).
+    # A real browser re-boots with the now-populated localStorage and
+    # converges; here the session state is already applied, so a recorded
+    # no-op keeps the run alive without re-executing the client.
+    env.reloads = []
     w.location = JSObject({
         "hash": "", "href": "http://testhost:8080/",
         "origin": "http://testhost:8080", "protocol": "http:",
         "host": "testhost:8080", "hostname": "testhost",
-        "pathname": "/", "search": ""})
+        "pathname": "/", "search": "",
+        "reload": NativeFunction(
+            lambda t, a, i: (env.reloads.append(1), UNDEF)[1], "reload")})
+    # the bare global `location` must be the same object (selkies-core.js
+    # uses both spellings)
+    g.vars["location"] = w.location
     w.localStorage = g.vars["localStorage"]
     w.isSecureContext = True
     w.postMessage = NativeFunction(

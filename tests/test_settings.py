@@ -90,3 +90,38 @@ def test_clamp_bool_accepts_numeric_strings():
 def test_schema_range_value_is_json_safe():
     import json
     json.dumps(mk().schema_payload())
+
+
+def test_enum_comma_list_restricts_allowed():
+    """Reference override semantics (reference settings.py:29-31): a comma
+    list restricts the allowed options and its first item is the default."""
+    s = mk(env={"SELKIES_ENCODER": "jpeg,x264enc"})
+    assert s.encoder == "jpeg"
+    entry = s.schema_payload()["settings"]["encoder"]
+    assert entry["value"] == "jpeg"
+    assert entry["allowed"] == ["jpeg", "x264enc"]
+    # clamp honors the restriction, not the spec-wide list
+    assert s.clamp_client_value("encoder", "x264enc-striped") == "jpeg"
+    assert s.clamp_client_value("encoder", "x264enc") == "x264enc"
+
+
+def test_enum_single_value_locks_choice():
+    s = mk(env={"SELKIES_ENCODER": "jpeg"})
+    assert s.encoder == "jpeg"
+    assert s.encoder.locked
+    entry = s.schema_payload()["settings"]["encoder"]
+    assert entry["allowed"] == ["jpeg"]
+    assert s.clamp_client_value("encoder", "x264enc") == "jpeg"
+
+
+def test_enum_default_keeps_full_allowed():
+    s = mk()
+    entry = s.schema_payload()["settings"]["encoder"]
+    assert entry["allowed"] == ["x264enc", "x264enc-striped", "jpeg"]
+    assert not s.encoder.locked
+
+
+def test_enum_rejects_unknown_in_list():
+    import pytest
+    with pytest.raises(ValueError):
+        mk(env={"SELKIES_ENCODER": "jpeg,notreal"})

@@ -222,6 +222,9 @@ class Context2D:
     def clearRect(self, *a):
         self.draw_calls.append(("clear",))
 
+    def setTransform(self, *a):
+        pass
+
     def fillRect(self, *a):
         pass
 

@@ -2048,6 +2048,9 @@ def _get_prop(self, obj, key):
             return m
         return UNDEF
     if isinstance(obj, bool):
+        m = _method(BOOL_METHODS, obj, key)
+        if m:
+            return m
         return UNDEF
     if isinstance(obj, float):
         m = _method(NUMBER_METHODS, obj, key)
@@ -2086,6 +2089,8 @@ def _get_prop(self, obj, key):
     if isinstance(obj, JSArrayBuffer):
         if key == "byteLength":
             return float(len(obj.data))
+        if key == "slice":
+            return obj.slice          # copying slice, like the spec's
         return UNDEF
     if isinstance(obj, JSDataView):
         if key == "byteLength":
@@ -2330,6 +2335,11 @@ NUMBER_METHODS = {
     "valueOf": lambda t, a, i: t,
 }
 
+BOOL_METHODS = {
+    "toString": lambda t, a, i: "true" if t else "false",
+    "valueOf": lambda t, a, i: t,
+}
+
 
 # ---- arrays
 
@@ -2369,6 +2379,8 @@ ARRAY_METHODS = {
         len(t.elems)))]),
     "splice": _arr_splice,
     "join": lambda t, a, i: to_str(_arg(a, 0, ",")).join(
+        "" if (e is UNDEF or e is None) else to_str(e) for e in t.elems),
+    "toString": lambda t, a, i: ",".join(
         "" if (e is UNDEF or e is None) else to_str(e) for e in t.elems),
     "indexOf": lambda t, a, i: float(next(
         (j for j, e in enumerate(t.elems)
