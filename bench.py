@@ -128,13 +128,38 @@ def bench_h264() -> dict:
         nb += sum(len(s.annexb) for s in out)
     elapsed = time.perf_counter() - start
     fps = done / elapsed if elapsed > 0 else 0.0
+
+    # Device-side truth (VERDICT r3 item 1): chain-slope over the
+    # already-compiled batched program. Chained dispatches + ONE tiny
+    # fetch; the difference between 4-deep and 2-deep chains cancels
+    # the fetch round trip, leaving (dispatch_rpc + B*frame)*2 — so
+    # frame_ms here is a slight OVERestimate (includes ~1/B of the
+    # dispatch RPC), i.e. device_fps is conservative.
+    import numpy as _np
+
+    def chain_ms(n_chains, reps=3):
+        best = 1e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(n_chains):
+                pends = enc.dispatch_batch(src.next_batch(BATCH),
+                                           fetch=False)
+            _np.asarray(pends[-1].batch_heads[0, :64])
+            best = min(best, (time.perf_counter() - t0) * 1000.0)
+        return best
+
+    t2, t4 = chain_ms(2), chain_ms(4)
+    dev_ms = max(0.0, (t4 - t2) / (2 * BATCH))
     return {
         "h264_1080p_fps": round(fps, 2),
         "h264_batch": BATCH,
         "h264_mean_frame_kb": round(nb / max(done, 1) / 1024, 1),
-        # remaining ceiling: the per-batch heads read (~1.2 MB over a
-        # 5-25 MB/s tunnel) + the serialized batch execution; both are
-        # sub-millisecond-class on PCIe hosts
+        "h264_device_ms_per_frame": round(dev_ms, 2),
+        "h264_device_fps": round(1000.0 / dev_ms, 1) if dev_ms > 0 else None,
+        "h264_device_note": (
+            "chain-slope of the one-dispatch batched program; cancels "
+            "fetch+fixed costs, includes ~1/B of dispatch RPC "
+            "(conservative). tools/h264_stages.py has the full method."),
         "h264_bottleneck": "per-batch D2H read over tunneled transport",
     }
 

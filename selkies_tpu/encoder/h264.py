@@ -248,7 +248,8 @@ class H264StripeEncoder:
     def __init__(self, width: int, height: int, *, stripe_height: int = 64,
                  qp: int = 26, paint_over_qp: int = 18,
                  paint_over_trigger_frames: int = 15,
-                 search: int = 12, fullframe: bool = False) -> None:
+                 search: int = 12, fullframe: bool = False,
+                 cap_frac: int = 8) -> None:
         if width % 2 or height % 2:
             raise ValueError("frame dimensions must be even")
         if stripe_height % MB:
@@ -294,8 +295,16 @@ class H264StripeEncoder:
         # bitmap prefix, then content-sized compacted cells. The fetch
         # prefix adapts to the previous frame's content (pipeline.py's
         # bucket strategy) so a mostly-static desktop ships a few KB.
+        # cap_frac=8 measured best end-to-end on the tunnel (55 fps vs
+        # 44 at the round-3 cap_frac=4) while halving the compaction's
+        # sort/gather domain (device 14.0 vs 20.5 ms/frame). cap_frac=32
+        # is another 3 ms/frame faster on the raw device slope (11.0 ms,
+        # 90 device-fps) but collapses the tunneled pipelined rate 3x —
+        # PCIe deployments, where D2H is bandwidth- not RPC-bound,
+        # should prefer it.
+        self._cap_frac = cap_frac
         self._pad_words, self._n_cells, self._cap_cells = \
-            dev.sparse_geometry(self._stripe_words)
+            dev.sparse_geometry(self._stripe_words, cap_frac)
         self._fixed_bytes = 4 * self.n_stripes \
             + self.n_stripes * (self._n_cells // 8)
         self._buf_bytes = self._fixed_bytes \
@@ -397,7 +406,7 @@ class H264StripeEncoder:
                     # programs, no per-bucket recompile churn; undershoot
                     # re-reads from buf
                     search=self.search, prefix=self._choose_prefix(),
-                    me=dev._me_backend())
+                    cap_frac=self._cap_frac, me=dev._me_backend())
             pending_buf = buf
             fetch_arr = head if fetch else None
         if fetch_arr is not None:
@@ -426,7 +435,13 @@ class H264StripeEncoder:
         paints = np.zeros((B, self.n_stripes), np.int8)
         for b in range(B):
             for i, st in enumerate(self.stripes):
-                if (st.static_frames >= self.paint_over_trigger
+                # forecast static_frames per in-batch offset (harvest has
+                # not advanced per-stripe history for frames still inside
+                # this batch): a stripe crossing the trigger mid-batch
+                # paints at the right frame, not up to B-1 frames late.
+                # If damage lands mid-batch instead, that frame emits at
+                # paint QP (extra quality, never a stale stripe).
+                if (st.static_frames + b >= self.paint_over_trigger
                         and not st.painted_over):
                     paints[b, i] = 1
                     st.painted_over = True
@@ -444,7 +459,7 @@ class H264StripeEncoder:
                 pad_h=self.pad_h, pad_w=self.pad_w,
                 n_stripes=self.n_stripes, sh=self.stripe_h,
                 search=self.search, prefix=prefix,
-                me=dev._me_backend())
+                cap_frac=self._cap_frac, me=dev._me_backend())
         if fetch:
             heads.copy_to_host_async()
         cache: Dict[str, np.ndarray] = {}   # shared host copy of heads

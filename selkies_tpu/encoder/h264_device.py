@@ -36,7 +36,8 @@ import numpy as np
 from ..ops import h264_transform as ht
 from ..ops.color import rgb_to_ycbcr, subsample_420
 from ..ops.motion import (full_search_mc, full_search_mc_scan,
-                          full_search_mv, mc_chroma, mc_luma)
+                          full_search_mv, mc_chroma, mc_luma,
+                          pad_replicate)
 from ..ops.pallas_me import me_mc_stripes
 
 MB = 16
@@ -228,6 +229,80 @@ def _stripe_view(plane, n_stripes, sh):
     return plane.reshape(n_stripes, sh, plane.shape[-1])
 
 
+def _collapse_mv_ties(cur, ref, ref_cb, ref_cr, mv,
+                      pred_y, pred_cb, pred_cr, *, search: int):
+    """Re-point SAD-tied macroblocks at the stripe's dominant motion.
+
+    The exhaustive search breaks SAD ties toward small |mv| per MB in
+    isolation. On desktop content that checkerboards flat regions
+    between mv=0 and the true motion, so the host coder's P_Skip runs
+    never form and every such MB pays mb_type+mvd+cbp syntax — measured
+    ~12x the bits of x264 superfast at equal PSNR on scrolling text
+    (tools/quality_measure.py, the round-4 quality gate). x264 solves
+    this with rate-aware MV costs inside the search; the TPU-shaped
+    equivalent is this whole-stripe post-pass: find the stripe's most
+    common winner, and move every MB whose SAD at that offset EQUALS
+    its winner's SAD (a true tie — quality is untouched) onto it. The
+    MV field then collapses to long uniform runs that skip/mvd-predict
+    to almost nothing. Pure XLA, so every ME backend shares it.
+
+    cur/ref: (h, w) uint8; ref_cb/ref_cr: (hc, wc) uint8.
+    """
+    h, w = cur.shape
+    hc, wc = ref_cb.shape
+    nby, nbx = h // MB, w // MB
+    n = 2 * search + 1
+
+    ridx = (mv[..., 0] + search) * n + (mv[..., 1] + search)
+    counts = (ridx.reshape(-1, 1)
+              == jnp.arange(n * n, dtype=jnp.int32)[None, :]).sum(0)
+    dom = jnp.argmax(counts).astype(jnp.int32)      # first max = lowest idx
+    ddy = dom // n - search
+    ddx = dom % n - search
+
+    # luma prediction at the dominant offset: one dynamic-base slice of
+    # the replicate-padded window (a fast DMA, not a gather)
+    win = pad_replicate(ref, search)
+    ref_dom = jax.lax.dynamic_slice(
+        win, (search + ddy, search + ddx), (h, w))
+    cur_i = cur.astype(jnp.int32)
+    sad_dom = jnp.abs(cur_i - ref_dom.astype(jnp.int32)) \
+        .reshape(nby, MB, nbx, MB).sum(axis=(1, 3))
+    sad_best = jnp.abs(cur_i - pred_y.astype(jnp.int32)) \
+        .reshape(nby, MB, nbx, MB).sum(axis=(1, 3))
+    take = sad_dom <= sad_best                       # == : a true tie
+
+    mv_new = jnp.where(take[..., None],
+                       jnp.stack([ddy, ddx]).astype(jnp.int32)[None, None],
+                       mv)
+    take_px = jnp.repeat(jnp.repeat(take, MB, 0), MB, 1)
+    pred_y2 = jnp.where(take_px, ref_dom.astype(jnp.uint8), pred_y)
+
+    # chroma at the dominant offset (§8.4.2.2.2: integer luma mv →
+    # {0,4}-eighth bilinear); arithmetic >> and & match the per-offset
+    # path in ops/motion.py chroma_pred
+    rc = search // 2 + 1
+    iy, ix = ddy >> 1, ddx >> 1
+    yf, xf = (ddy & 1) * 4, (ddx & 1) * 4
+    out_c = []
+    for cp in (ref_cb, ref_cr):
+        cpad = pad_replicate(cp.astype(jnp.int32), rc + 1)
+        a = jax.lax.dynamic_slice(
+            cpad, (rc + 1 + iy, rc + 1 + ix), (hc + 1, wc + 1))
+        tl = a[:hc, :wc]
+        tr = a[:hc, 1:]
+        bl = a[1:, :wc]
+        br = a[1:, 1:]
+        acc = ((8 - xf) * (8 - yf) * tl + xf * (8 - yf) * tr
+               + (8 - xf) * yf * bl + xf * yf * br + 32) >> 6
+        out_c.append(acc.astype(jnp.uint8))
+    cb2 = MB // 2
+    take_cx = jnp.repeat(jnp.repeat(take, cb2, 0), cb2, 1)
+    pred_cb2 = jnp.where(take_cx, out_c[0], pred_cb)
+    pred_cr2 = jnp.where(take_cx, out_c[1], pred_cr)
+    return mv_new, pred_y2, pred_cb2, pred_cr2
+
+
 def _frame_p_core(y, cb, cr, prev_y, prev_cb, prev_cr,
                   ref_y, ref_cb, ref_cr, paint, qp, paint_qp,
                   *, n_stripes: int, sh: int, search: int,
@@ -274,6 +349,12 @@ def _frame_p_core(y, cb, cr, prev_y, prev_cb, prev_cr,
         mv, pred_y, pred_cb, pred_cr = jax.vmap(
             functools.partial(fn, mb=MB, search=search)
         )(ys, rys, rcbs, rcrs)
+    # SAD-tied MBs re-point at each stripe's dominant motion so skip
+    # runs form (same quality, far fewer syntax bits — see
+    # _collapse_mv_ties); shared across every ME backend
+    mv, pred_y, pred_cb, pred_cr = jax.vmap(
+        functools.partial(_collapse_mv_ties, search=search)
+    )(ys, rys, rcbs, rcrs, mv, pred_y, pred_cb, pred_cr)
     enc = jax.vmap(encode_stripe_p_pred)(
         ys, cbs, crs, mv, pred_y, pred_cb, pred_cr, qps)
 

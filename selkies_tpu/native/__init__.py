@@ -139,6 +139,16 @@ def _register_conformance(lib: ctypes.CDLL) -> None:
     lib.conf_dec_flush.argtypes = [ctypes.c_void_p, _u8p, _u8p, _u8p,
                                    *caps, i32p, i32p]
     lib.conf_dec_flush.restype = ctypes.c_int
+    # x264 reference encoder (quality-gate tooling)
+    lib.conf_x264_new.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_char_p]
+    lib.conf_x264_new.restype = ctypes.c_void_p
+    lib.conf_enc_free.argtypes = [ctypes.c_void_p]
+    lib.conf_enc_encode.argtypes = [ctypes.c_void_p, _u8p, _u8p, _u8p,
+                                    _u8p, ctypes.c_int64]
+    lib.conf_enc_encode.restype = ctypes.c_int64
+    lib.conf_enc_flush.argtypes = [ctypes.c_void_p, _u8p, ctypes.c_int64]
+    lib.conf_enc_flush.restype = ctypes.c_int64
 
 
 def _register_audio(lib: ctypes.CDLL) -> None:

@@ -141,3 +141,128 @@ int conf_dec_flush(void *h, uint8_t *y, uint8_t *u, uint8_t *v,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Reference x264 encoder (quality-gate tooling, VERDICT r3 item 4).
+//
+// The reference's daily driver is pixelflux's x264 at preset superfast with
+// zerolatency tuning (reference gstwebrtc_app.py:609-640 x264enc
+// speed-preset=superfast tune=zerolatency). tools/quality_measure.py encodes
+// the same frames through THIS encoder and through tpuenc-H.264 and compares
+// rate/distortion — the gate that decides whether deblocking/sub-pel/intra-4x4
+// are worth building.  Tooling only, never on the streaming path.
+
+namespace {
+
+struct Enc {
+    AVCodecContext *ctx = nullptr;
+    AVFrame *frame = nullptr;
+    AVPacket *pkt = nullptr;
+    int64_t pts = 0;
+};
+
+void enc_free(Enc *e) {
+    if (!e) return;
+    if (e->pkt) av_packet_free(&e->pkt);
+    if (e->frame) av_frame_free(&e->frame);
+    if (e->ctx) avcodec_free_context(&e->ctx);
+    delete e;
+}
+
+}  // namespace
+
+extern "C" {
+
+// crf >= 0 selects CRF rate control; bitrate_kbps > 0 selects ABR instead.
+void *conf_x264_new(int w, int h, int crf, int bitrate_kbps,
+                    const char *preset) {
+    const AVCodec *codec = avcodec_find_encoder_by_name("libx264");
+    if (!codec) return nullptr;
+    Enc *e = new Enc();
+    e->ctx = avcodec_alloc_context3(codec);
+    if (!e->ctx) { delete e; return nullptr; }
+    e->ctx->width = w;
+    e->ctx->height = h;
+    e->ctx->time_base = {1, 60};
+    e->ctx->framerate = {60, 1};
+    e->ctx->pix_fmt = AV_PIX_FMT_YUV420P;
+    e->ctx->gop_size = 600;            // streaming posture: IDR then P's
+    e->ctx->max_b_frames = 0;
+    AVDictionary *opts = nullptr;
+    av_dict_set(&opts, "preset", preset ? preset : "superfast", 0);
+    av_dict_set(&opts, "tune", "zerolatency", 0);
+    if (crf >= 0) {
+        char buf[16];
+        snprintf(buf, sizeof buf, "%d", crf);
+        av_dict_set(&opts, "crf", buf, 0);
+    } else if (bitrate_kbps > 0) {
+        e->ctx->bit_rate = (int64_t)bitrate_kbps * 1000;
+    }
+    if (avcodec_open2(e->ctx, codec, &opts) < 0) {
+        av_dict_free(&opts);
+        enc_free(e);
+        return nullptr;
+    }
+    av_dict_free(&opts);
+    e->frame = av_frame_alloc();
+    e->pkt = av_packet_alloc();
+    e->frame->format = AV_PIX_FMT_YUV420P;
+    e->frame->width = w;
+    e->frame->height = h;
+    if (av_frame_get_buffer(e->frame, 0) < 0) { enc_free(e); return nullptr; }
+    return e;
+}
+
+void conf_enc_free(void *h) { enc_free((Enc *)h); }
+
+// Encode one tightly-packed YUV420 frame; appends any produced packets to
+// `out` (Annex-B) and returns bytes written (0 = buffered), negative on error.
+int64_t conf_enc_encode(void *h, const uint8_t *y, const uint8_t *u,
+                        const uint8_t *v, uint8_t *out, int64_t out_cap) {
+    Enc *e = (Enc *)h;
+    if (!e) return -1;
+    if (av_frame_make_writable(e->frame) < 0) return -2;
+    const int w = e->ctx->width, hgt = e->ctx->height;
+    for (int r = 0; r < hgt; ++r)
+        memcpy(e->frame->data[0] + (size_t)r * e->frame->linesize[0],
+               y + (size_t)r * w, w);
+    const int cw = (w + 1) / 2, ch = (hgt + 1) / 2;
+    for (int r = 0; r < ch; ++r) {
+        memcpy(e->frame->data[1] + (size_t)r * e->frame->linesize[1],
+               u + (size_t)r * cw, cw);
+        memcpy(e->frame->data[2] + (size_t)r * e->frame->linesize[2],
+               v + (size_t)r * cw, cw);
+    }
+    e->frame->pts = e->pts++;
+    if (avcodec_send_frame(e->ctx, e->frame) < 0) return -3;
+    int64_t n = 0;
+    while (true) {
+        int rc = avcodec_receive_packet(e->ctx, e->pkt);
+        if (rc == AVERROR(EAGAIN) || rc == AVERROR_EOF) break;
+        if (rc < 0) return -4;
+        if (n + e->pkt->size > out_cap) { av_packet_unref(e->pkt); return -6; }
+        memcpy(out + n, e->pkt->data, e->pkt->size);
+        n += e->pkt->size;
+        av_packet_unref(e->pkt);
+    }
+    return n;
+}
+
+int64_t conf_enc_flush(void *h, uint8_t *out, int64_t out_cap) {
+    Enc *e = (Enc *)h;
+    if (!e) return -1;
+    if (avcodec_send_frame(e->ctx, nullptr) < 0) return -3;
+    int64_t n = 0;
+    while (true) {
+        int rc = avcodec_receive_packet(e->ctx, e->pkt);
+        if (rc == AVERROR(EAGAIN) || rc == AVERROR_EOF) break;
+        if (rc < 0) return -4;
+        if (n + e->pkt->size > out_cap) { av_packet_unref(e->pkt); return -6; }
+        memcpy(out + n, e->pkt->data, e->pkt->size);
+        n += e->pkt->size;
+        av_packet_unref(e->pkt);
+    }
+    return n;
+}
+
+}  // extern "C"

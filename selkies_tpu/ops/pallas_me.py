@@ -55,8 +55,8 @@ def _rank_table(search: int) -> np.ndarray:
 
 def _me_mc_kernel(ranks_ref, cur_ref, ref_ref, cb_ref, cr_ref,
                   rank_out, py_out, pcb_out, pcr_out,
-                  best_sad, best_rank, *, search: int, h: int, w: int,
-                  hc: int, wc: int):
+                  best_sad, best_rank, *, search: int, h: int,
+                  w: int, hc: int, wc: int):
     nby, nbx = h // MB, w // MB
     n_dy = 2 * search + 1
     cur = cur_ref[0].astype(jnp.int32)                    # (h, w)
@@ -139,47 +139,63 @@ def _me_mc_kernel(ranks_ref, cur_ref, ref_ref, cb_ref, cr_ref,
     cr_all = cr_ref[0].astype(jnp.int32)
 
     def body2(dyi, _):
-        rolled = pltpu.roll(win_all, win_all.shape[0] - dyi, 0)[:h]
-        dy = dyi - search
-        iy = dy >> 1
-        yf = (dy & 1) * 4
-        y0 = rc + 1 + iy
-        cb_roll = pltpu.roll(cb_all, cb_all.shape[0] - y0, 0)
-        cr_roll = pltpu.roll(cr_all, cr_all.shape[0] - y0, 0)
+        # Gate whole dy rows on "some block's winner lives in this row":
+        # the rolls + 25 per-dx mask/update bodies below were measured at
+        # ~5.3 of the kernel's 8.3 ms/frame when run unconditionally,
+        # while typical desktop motion has 1-2 winning dy rows, not 25.
+        # The membership test is 25 vector compares of the (nby, nbx)
+        # winner grid — noise next to one skipped roll. (A pass-1 SMEM
+        # winner-flag scratch was tried first; scratch carried between
+        # two fori_loops faults Mosaic inside lax.scan programs.)
+        row_hit = jnp.zeros((nby, nbx), jnp.bool_)
         for dxi in range(n_dy):
-            dx = dxi - search
-            rank = ranks_ref[dyi, dxi]
-            take = win_rank == rank                      # (nby, nbx)
-            # chroma lane geometry, xf folded in statically
-            # (§8.4.2.2.2: integer luma mv → {0,4}-eighth weights)
-            ix = dx >> 1
-            xf = (dx & 1) * 4
-            x0 = rc + 1 + ix
+            row_hit = row_hit | (win_rank == ranks_ref[dyi, dxi])
 
-            @pl.when(jnp.any(take))
-            def _(take=take, dxi=dxi, x0=x0, xf=xf):
-                tpx = expand_mask(take, rexp_y, cexp_y)
-                py_out[0] = jnp.where(
-                    tpx, rolled[:, dxi:dxi + w].astype(jnp.uint8),
-                    py_out[0])
+        @pl.when(jnp.any(row_hit))
+        def _(dyi=dyi):
+            rolled = pltpu.roll(win_all, win_all.shape[0] - dyi, 0)[:h]
+            dy = dyi - search
+            iy = dy >> 1
+            yf = (dy & 1) * 4
+            y0 = rc + 1 + iy
+            cb_roll = pltpu.roll(cb_all, cb_all.shape[0] - y0, 0)
+            cr_roll = pltpu.roll(cr_all, cr_all.shape[0] - y0, 0)
+            for dxi in range(n_dy):
+                dx = dxi - search
+                rank = ranks_ref[dyi, dxi]
+                take = win_rank == rank                  # (nby, nbx)
+                # chroma lane geometry, xf folded in statically
+                # (§8.4.2.2.2: integer luma mv → {0,4}-eighth weights)
+                ix = dx >> 1
+                xf = (dx & 1) * 4
+                x0 = rc + 1 + ix
 
-                def ctap(roll_c, off):
-                    a = roll_c[off:off + hc, x0:x0 + wc]
-                    if xf == 0:
-                        return a * 8
-                    return (a * (8 - xf)
-                            + roll_c[off:off + hc,
-                                     x0 + 1:x0 + 1 + wc] * xf)
+                @pl.when(jnp.any(take))
+                def _(take=take, dxi=dxi, x0=x0, xf=xf,
+                      rolled=rolled, cb_roll=cb_roll, cr_roll=cr_roll,
+                      yf=yf):
+                    tpx = expand_mask(take, rexp_y, cexp_y)
+                    py_out[0] = jnp.where(
+                        tpx, rolled[:, dxi:dxi + w].astype(jnp.uint8),
+                        py_out[0])
 
-                ncb = ((8 - yf) * ctap(cb_roll, 0)
-                       + yf * ctap(cb_roll, 1) + 32) >> 6
-                ncr = ((8 - yf) * ctap(cr_roll, 0)
-                       + yf * ctap(cr_roll, 1) + 32) >> 6
-                tcx = expand_mask(take, rexp_c, cexp_c)
-                pcb_out[0] = jnp.where(tcx, ncb.astype(jnp.uint8),
-                                       pcb_out[0])
-                pcr_out[0] = jnp.where(tcx, ncr.astype(jnp.uint8),
-                                       pcr_out[0])
+                    def ctap(roll_c, off):
+                        a = roll_c[off:off + hc, x0:x0 + wc]
+                        if xf == 0:
+                            return a * 8
+                        return (a * (8 - xf)
+                                + roll_c[off:off + hc,
+                                         x0 + 1:x0 + 1 + wc] * xf)
+
+                    ncb = ((8 - yf) * ctap(cb_roll, 0)
+                           + yf * ctap(cb_roll, 1) + 32) >> 6
+                    ncr = ((8 - yf) * ctap(cr_roll, 0)
+                           + yf * ctap(cr_roll, 1) + 32) >> 6
+                    tcx = expand_mask(take, rexp_c, cexp_c)
+                    pcb_out[0] = jnp.where(tcx, ncb.astype(jnp.uint8),
+                                           pcb_out[0])
+                    pcr_out[0] = jnp.where(tcx, ncr.astype(jnp.uint8),
+                                           pcr_out[0])
 
         return 0
 

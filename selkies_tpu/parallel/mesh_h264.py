@@ -1,0 +1,476 @@
+"""Multi-session H.264 encode over a ("session", "stripe") device mesh.
+
+Round-3 verdict item 3: the mesh path was hard-gated to JPEG while the
+config-4 memo sold an H.264-on-mesh projection. This module makes the
+H.264 profile a real mesh citizen: sessions are data-parallel on the
+"session" axis and each frame's height is sharded on stripe boundaries
+on the "stripe" axis — legal because every stripe is an independent
+video sequence (its own SPS/PPS/IDR chain and VideoDecoder client-side,
+reference selkies-core.js:2925-2968), so motion estimation, the
+reconstruction chain and the sparse level pack all stay shard-local.
+Only nothing crosses the ICI per tick; the per-stripe CAVLC runs on the
+host thread pool exactly as the solo path does (encoder/h264.py).
+
+IDR handling keeps the dispatch SPMD-uniform: a joining session must
+not force whole-batch keyframes or a divergent program, so the step
+comes in two compiled flavors — a steady-state P-only program, and a
+"mixed" program that additionally computes the Intra16x16 encode for
+every stripe and SELECTS per stripe between intra and inter outputs.
+The host dispatches the mixed program only on ticks where some stripe
+needs an IDR (join/reset/entropy-resync); intra levels routinely exceed
+int8, which the sparse pack already reports per stripe as overflow, so
+the host recovers exact IDR levels from the flat16 rows it keeps on
+device — the same fallback the solo encoder uses.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..encoder import h264_device as dev
+from ..encoder.h264 import H264Stripe, encode_picture_nals_np, make_pps, make_sps
+from ..encoder.h264 import _entropy_pool
+
+logger = logging.getLogger("selkies_tpu.parallel.h264")
+
+MB = 16
+
+
+def _merge_idr(enc_p: dev.StripeEncodeOut, enc_i: dev.StripeEncodeOut,
+               idr) -> dev.StripeEncodeOut:
+    """Per-stripe select between the inter and intra encodes.
+
+    ``idr``: [S] bool/int. Every StripeEncodeOut field carries the stripe
+    dim first, so a broadcasted where merges the two programs' outputs.
+    """
+    def sel(a, b):
+        flag = idr.reshape((idr.shape[0],) + (1,) * (a.ndim - 1))
+        return jnp.where(flag != 0, a, b)
+
+    return dev.StripeEncodeOut(*[sel(a, b) for a, b in zip(enc_i, enc_p)])
+
+
+def make_h264_mesh_step(mesh: Mesh, pad_h: int, pad_w: int, stripe_h: int,
+                        *, search: int = dev.SEARCH, cap_frac: int = 4,
+                        me: str = "xla", with_idr: bool = False,
+                        prefix: int = 0):
+    """Build the jitted sharded multi-session H.264 step.
+
+    Returns (fn, s_local): fn(frames, prev_y, prev_cb, prev_cr, ref_y,
+    ref_cb, ref_cr, paint, idr, qp, paint_qp) →
+      (buf [N, stripe_ax, L], flat16 [N, S, words], prev planes, refs).
+
+    frames [N, pad_h, pad_w, 3] uint8, sharded P("session", "stripe");
+    plane state shards the same way; paint/idr are [N, S] int32 sharded
+    on ("session", "stripe"). ``me`` defaults to the XLA chunked search:
+    the Pallas kernel assumes the TPU backend, and the mesh path must
+    also run on the CPU test mesh — TPU deployments pass me="pallas".
+    """
+    n_stripe_ax = mesh.shape["stripe"]
+    if pad_h % (n_stripe_ax * stripe_h):
+        raise ValueError("pad_h must divide into stripe_ax × stripe_h bands")
+    h_local = pad_h // n_stripe_ax
+    s_local = h_local // stripe_h
+
+    def one(rgb, py1, pcb1, pcr1, ry1, rcb1, rcr1, paint1, idr1,
+            qp, paint_qp):
+        y, cb, cr = dev.prepare_planes(rgb, h_local, pad_w)
+        enc, damage, update, nry, nrcb, nrcr = dev._frame_p_core(
+            y, cb, cr, py1, pcb1, pcr1, ry1, rcb1, rcr1,
+            paint1, qp, paint_qp, n_stripes=s_local, sh=stripe_h,
+            search=search, me=me)
+        if with_idr:
+            ys = y.reshape(s_local, stripe_h, pad_w)
+            cbs = cb.reshape(s_local, stripe_h // 2, pad_w // 2)
+            crs = cr.reshape(s_local, stripe_h // 2, pad_w // 2)
+            qps = jnp.broadcast_to(qp, (s_local,))
+            enc_i = jax.vmap(dev.encode_stripe_idr)(ys, cbs, crs, qps)
+            enc = _merge_idr(enc, enc_i, idr1)
+            damage = damage | (idr1 != 0)
+            update = update | (idr1 != 0)
+            sel = (idr1 != 0)[:, None, None]
+            nry = jnp.where(
+                sel, enc_i.recon_y, nry.reshape(s_local, stripe_h, pad_w)
+            ).reshape(h_local, pad_w)
+            nrcb = jnp.where(
+                sel, enc_i.recon_cb,
+                nrcb.reshape(s_local, stripe_h // 2, pad_w // 2)
+            ).reshape(h_local // 2, pad_w // 2)
+            nrcr = jnp.where(
+                sel, enc_i.recon_cr,
+                nrcr.reshape(s_local, stripe_h // 2, pad_w // 2)
+            ).reshape(h_local // 2, pad_w // 2)
+        flat16, _ = dev._pack_levels(enc, damage, update)
+        buf = dev._pack_sparse(flat16, damage, update, cap_frac=cap_frac)
+        # byte-prefix of the content-compacted buffer (head + bitmap +
+        # compacted cells), same contract as the solo encoder's
+        # two-tier head; harvest refetches exact rows on undershoot
+        if prefix:
+            buf = buf[:prefix]
+        return buf, flat16, y, cb, cr, nry, nrcb, nrcr
+
+    def local_step(frames, prev_y, prev_cb, prev_cr,
+                   ref_y, ref_cb, ref_cr, paint, idr, qp, paint_qp):
+        buf, flat16, y, cb, cr, nry, nrcb, nrcr = jax.vmap(
+            one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, None, None)
+        )(frames, prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr,
+          paint, idr, qp, paint_qp)
+        return (buf[:, None, :], flat16, y, cb, cr, nry, nrcb, nrcr)
+
+    plane = P("session", "stripe")
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(plane, plane, plane, plane, plane, plane, plane,
+                  plane, plane, P(), P()),
+        out_specs=(
+            P("session", "stripe", None),   # buf [N, stripe_ax, L]
+            P("session", "stripe", None),   # flat16 [N, S, words]
+            plane, plane, plane,            # prev planes
+            plane, plane, plane,            # refs
+        ),
+    )
+    return jax.jit(sharded, donate_argnums=(1, 2, 3, 4, 5, 6)), s_local
+
+
+@dataclass
+class _MeshH264Pending:
+    prefix: Any                   # async-fetching [N, stripe_ax, prefix]
+    buf: Any                      # full packed buffer (undershoot refetch)
+    flat16: Any                   # [N, S, words] exact levels (device)
+    idr: np.ndarray               # [N, S] bool — dispatched as IDR
+    paint: np.ndarray             # [N, S] bool
+    reuse_prev: np.ndarray        # [N] bool
+    qp: np.ndarray                # [N, S] int — qp each stripe coded at
+
+
+class MeshH264Encoder:
+    """N solo H264StripeEncoders collapsed into one SPMD program.
+
+    Mirrors MeshStripeEncoder's shape (dispatch/harvest/facade-friendly
+    control surface) with the solo H264StripeEncoder's per-stripe host
+    state (frame_num, idr_pic_id, damage/paint history, CAVLC pool).
+    """
+
+    def __init__(self, mesh: Mesh, n_sessions: int, width: int, height: int,
+                 *, stripe_h: int = 64, qp: int = 26, paint_over_qp: int = 18,
+                 use_paint_over_quality: bool = True,
+                 paint_over_trigger_frames: int = 15,
+                 search: int = dev.SEARCH, me: Optional[str] = None) -> None:
+        n_sess_ax = mesh.shape["session"]
+        self.n_stripe_ax = mesh.shape["stripe"]
+        if n_sessions % n_sess_ax:
+            raise ValueError(
+                f"{n_sessions} sessions not divisible by session axis "
+                f"{n_sess_ax}")
+        if stripe_h % MB:
+            raise ValueError("stripe_h must be a multiple of 16")
+        if width % 2 or height % 2:
+            raise ValueError("frame dimensions must be even")
+        band = self.n_stripe_ax * stripe_h
+        self.width, self.height = width, height
+        self.pad_w = -(-width // MB) * MB
+        self.pad_h = -(-height // band) * band
+        self.stripe_h = stripe_h
+        self.n_stripes = self.pad_h // stripe_h
+        self.n_sessions = n_sessions
+        self.mesh = mesh
+        self.qp = int(np.clip(qp, 0, 51))
+        self.paint_over_qp = int(np.clip(paint_over_qp, 0, 51))
+        self.use_paint_over_quality = bool(use_paint_over_quality)
+        self.paint_over_trigger = int(paint_over_trigger_frames)
+        self.search = search
+        if me is None:
+            me = "pallas" if jax.default_backend() == "tpu" else "xla"
+        self.me = me
+
+        n = (stripe_h // MB) * (self.pad_w // MB)
+        self._shapes = [((n, 2), 2 * n), ((n, 16, 4, 4), 256 * n),
+                        ((n, 4, 4), 16 * n), ((n, 2, 2, 2), 8 * n),
+                        ((n, 2, 4, 4, 4), 128 * n)]
+        self._stripe_words = sum(s for _, s in self._shapes)
+        self.s_local = self.pad_h // self.n_stripe_ax // stripe_h
+        self._cap_frac = 8
+        self._pad_words, self._n_cells, self._cap_cells = \
+            dev.sparse_geometry(self._stripe_words, self._cap_frac)
+        self._fixed_bytes = 4 * self.s_local \
+            + self.s_local * (self._n_cells // 8)
+        self._buf_bytes = self._fixed_bytes \
+            + self._cap_cells * self.s_local * dev.CELL
+        #: per-(session, shard) fetch prefix over the content-compacted
+        #: buffer (same layout as the solo encoder); an undershoot falls
+        #: back to exact flat16 rows and grows the bucket
+        self._prefix = self._bucket(self._fixed_bytes + (32 << 10))
+
+        self._steps: Dict[Tuple[bool, int], Any] = {}
+
+        plane = NamedSharding(mesh, P("session", "stripe"))
+        self._plane_sharding = plane
+        self._frame_sharding = plane
+        z = functools.partial(jax.device_put)
+        self._prev_y = z(jnp.zeros((n_sessions, self.pad_h, self.pad_w),
+                                   jnp.uint8), plane)
+        self._prev_cb = z(jnp.zeros(
+            (n_sessions, self.pad_h // 2, self.pad_w // 2), jnp.uint8), plane)
+        self._prev_cr = z(jnp.zeros_like(self._prev_cb), plane)
+        self._ref_y = z(jnp.zeros_like(self._prev_y), plane)
+        self._ref_cb = z(jnp.zeros_like(self._prev_cb), plane)
+        self._ref_cr = z(jnp.zeros_like(self._prev_cr), plane)
+
+        S = self.n_stripes
+        self._need_idr = np.ones((n_sessions, S), bool)
+        self._frame_num = np.zeros((n_sessions, S), np.int64)
+        self._idr_pic_id = np.zeros((n_sessions, S), np.int64)
+        self._static = np.zeros((n_sessions, S), np.int64)
+        self._painted = np.zeros((n_sessions, S), bool)
+        self._last_host = np.zeros(
+            (n_sessions, self.pad_h, self.pad_w, 3), np.uint8)
+        self._sps_pps: Dict[int, bytes] = {}
+
+    # -- control -----------------------------------------------------------
+
+    def force_keyframe(self, session: int) -> None:
+        self._need_idr[session] = True
+        self._static[session] = 0
+        self._painted[session] = False
+
+    def reset_session(self, session: int) -> None:
+        """Recycle a slot: fresh history AND zeroed planes so no pixels
+        leak across occupants (the inter refs would otherwise carry
+        them — the exact hazard VERDICT r2 flagged for mesh inter)."""
+        self.force_keyframe(session)
+        self._frame_num[session] = 0
+        self._last_host[session] = 0
+        put = functools.partial(jax.device_put)
+        for name in ("_prev_y", "_prev_cb", "_prev_cr",
+                     "_ref_y", "_ref_cb", "_ref_cr"):
+            arr = getattr(self, name)
+            setattr(self, name, put(
+                jnp.asarray(arr).at[session].set(0), self._plane_sharding))
+
+    # -- helpers -----------------------------------------------------------
+
+    def _bucket(self, nbytes: int) -> int:
+        n = 4096
+        while n < nbytes:
+            n <<= 1
+        return min(n, self._buf_bytes)
+
+    def _step_for(self, with_idr: bool, prefix: int):
+        key = (with_idr, prefix)
+        fn = self._steps.get(key)
+        if fn is None:
+            fn, _ = make_h264_mesh_step(
+                self.mesh, self.pad_h, self.pad_w, self.stripe_h,
+                search=self.search, me=self.me, with_idr=with_idr,
+                cap_frac=self._cap_frac, prefix=prefix)
+            self._steps[key] = fn
+        return fn
+
+    def _sps_pps_for(self, h: int) -> bytes:
+        if h not in self._sps_pps:
+            self._sps_pps[h] = (
+                make_sps(self.width, h, coded_height=self.stripe_h)
+                + make_pps())
+        return self._sps_pps[h]
+
+    def _pad(self, frame: np.ndarray) -> np.ndarray:
+        if frame.shape[0] == self.pad_h and frame.shape[1] == self.pad_w:
+            return frame
+        return np.pad(
+            frame,
+            ((0, self.pad_h - frame.shape[0]),
+             (0, self.pad_w - frame.shape[1]), (0, 0)),
+            mode="edge")
+
+    # -- per-tick ----------------------------------------------------------
+
+    def dispatch(self, frames) -> _MeshH264Pending:
+        """One sharded step for all sessions; pair with :meth:`harvest`.
+
+        ``frames``: [N, H, W, 3] array or length-N sequence (None entries
+        re-present the previous frame; damage gating suppresses them).
+        """
+        reuse_prev = np.zeros(self.n_sessions, bool)
+        if isinstance(frames, np.ndarray) and frames.ndim == 4:
+            for n in range(self.n_sessions):
+                self._last_host[n] = self._pad(np.asarray(frames[n], np.uint8))
+        else:
+            for n, f in enumerate(frames):
+                if f is None:
+                    reuse_prev[n] = True
+                else:
+                    self._last_host[n] = self._pad(np.asarray(f, np.uint8))
+
+        idr = self._need_idr & ~reuse_prev[:, None]
+        paint = (self.use_paint_over_quality
+                 & (self._static >= self.paint_over_trigger)
+                 & ~self._painted & ~idr)
+        paint &= ~reuse_prev[:, None]
+        # optimistic arming (cleared by damage at harvest) — in-flight
+        # ticks must not re-trigger
+        self._painted |= paint
+        self._need_idr &= reuse_prev[:, None]
+
+        qp_arr = np.where(paint, self.paint_over_qp, self.qp)
+        fn = self._step_for(bool(idr.any()), self._prefix)
+        frames_d = jax.device_put(jnp.asarray(self._last_host),
+                                  self._frame_sharding)
+        paint_d = jax.device_put(jnp.asarray(paint.astype(np.int32)),
+                                 self._plane_sharding)
+        idr_d = jax.device_put(jnp.asarray(idr.astype(np.int32)),
+                               self._plane_sharding)
+        (prefix, flat16, self._prev_y, self._prev_cb, self._prev_cr,
+         self._ref_y, self._ref_cb, self._ref_cr) = fn(
+            frames_d, self._prev_y, self._prev_cb, self._prev_cr,
+            self._ref_y, self._ref_cb, self._ref_cr,
+            paint_d, idr_d, jnp.int32(self.qp),
+            jnp.int32(self.paint_over_qp))
+        prefix.copy_to_host_async()
+        return _MeshH264Pending(
+            prefix=prefix, buf=None, flat16=flat16, idr=idr,
+            paint=paint, reuse_prev=reuse_prev, qp=qp_arr)
+
+    def harvest(self, p: _MeshH264Pending
+                ) -> Tuple[List[List[H264Stripe]], np.ndarray]:
+        """Entropy-code one dispatched tick. Returns (stripes per session,
+        coded bytes per session). Must be called in dispatch order."""
+        host = np.asarray(p.prefix)          # [N, stripe_ax, prefix]
+        S, sl = self.n_stripes, self.s_local
+        CELL = dev.CELL
+
+        counts = np.zeros((self.n_sessions, S), np.int64)
+        damage = np.zeros((self.n_sessions, S), bool)
+        ovf = np.zeros((self.n_sessions, S), bool)
+        for k in range(self.n_stripe_ax):
+            head = host[:, k, :4 * sl].reshape(self.n_sessions, sl, 4)
+            gs = slice(k * sl, (k + 1) * sl)
+            counts[:, gs] = head[:, :, 0].astype(np.int64) \
+                + (head[:, :, 1].astype(np.int64) << 8)
+            damage[:, gs] = head[:, :, 2] != 0
+            ovf[:, gs] = head[:, :, 3] != 0
+
+        damage[p.reuse_prev] = False
+        emit = damage | p.paint | p.idr
+        self._static = np.where(damage, 0, self._static + 1)
+        self._painted = np.where(damage, False, self._painted)
+
+        # content-compacted cells (same layout as the solo encoder): per
+        # shard, used = min(count, cap)*CELL bytes back to back after the
+        # fixed head+bitmap. An undershoot (compacted content past the
+        # fetched prefix) or per-stripe overflow (count > cap, |level| >
+        # 127 — IDR levels routinely do) recovers from the exact flat16
+        # rows; reads start before any blocks.
+        used = np.minimum(counts, self._cap_cells) * CELL
+        grew = False
+        for n in range(self.n_sessions):
+            for k in range(self.n_stripe_ax):
+                gs = slice(k * sl, (k + 1) * sl)
+                if not emit[n, gs].any():
+                    continue
+                needed = self._fixed_bytes + int(used[n, gs].sum())
+                if needed > host.shape[-1]:
+                    ovf[n, gs] |= emit[n, gs]
+                    if not grew:
+                        self._prefix = self._bucket(needed + needed // 2)
+                        grew = True
+        exact: Dict[Tuple[int, int], Any] = {}
+        for n in range(self.n_sessions):
+            for g in range(S):
+                if emit[n, g] and ovf[n, g]:
+                    row = p.flat16[n, g]
+                    row.copy_to_host_async()
+                    exact[(n, g)] = row
+
+        mb_w = self.pad_w // MB
+        mb_h = self.stripe_h // MB
+        jobs = []
+        for n in range(self.n_sessions):
+            for g in range(S):
+                if not emit[n, g]:
+                    continue
+                k, s = g // sl, g % sl
+                if ovf[n, g]:
+                    row = np.asarray(exact[(n, g)]).astype(np.int32)
+                else:
+                    bitmap = host[n, k, 4 * sl:self._fixed_bytes] \
+                        .reshape(sl, self._n_cells // 8)[s]
+                    bits = np.unpackbits(bitmap, bitorder="little")
+                    idx = np.flatnonzero(bits[:self._n_cells])
+                    gs0 = k * sl
+                    start = self._fixed_bytes \
+                        + int(used[n, gs0:g].sum())
+                    cells = host[n, k, start:start + used[n, g]] \
+                        .view(np.int8).astype(np.int32) \
+                        .reshape(-1, CELL)
+                    dense = np.zeros(self._pad_words, np.int32)
+                    dense.reshape(-1, CELL)[idx[:len(cells)]] = cells
+                    row = dense[:self._stripe_words]
+                parts, pos = [], 0
+                for shape, size in self._shapes:
+                    parts.append(row[pos:pos + size].reshape(shape))
+                    pos += size
+                jobs.append((n, g, bool(p.idr[n, g]), int(p.qp[n, g]), parts))
+
+        def run_one(job):
+            n, g, is_key, qp, parts = job
+            mv, luma, luma_dc, chroma_dc, chroma_ac = parts
+            if is_key:
+                return encode_picture_nals_np(
+                    mv, luma, luma_dc, chroma_dc, chroma_ac,
+                    is_idr=True, mb_w=mb_w, mb_h=mb_h, qp=qp, frame_num=0,
+                    idr_pic_id=int(self._idr_pic_id[n, g]))
+            return encode_picture_nals_np(
+                mv, luma, luma_dc, chroma_dc, chroma_ac,
+                is_idr=False, mb_w=mb_w, mb_h=mb_h, qp=qp,
+                frame_num=int(self._frame_num[n, g]))
+
+        def safe_one(job):
+            try:
+                return run_one(job)
+            except Exception as exc:
+                return exc
+
+        payloads = list(_entropy_pool().map(safe_one, jobs)) \
+            if len(jobs) > 1 else [safe_one(j) for j in jobs]
+
+        out: List[List[H264Stripe]] = [[] for _ in range(self.n_sessions)]
+        coded = np.zeros(self.n_sessions, np.int64)
+        for job, payload in zip(jobs, payloads):
+            n, g, is_key, qp, _ = job
+            if isinstance(payload, Exception):
+                logger.error("mesh CAVLC failed for session %d stripe %d; "
+                             "forcing IDR resync", n, g, exc_info=payload)
+                self._need_idr[n, g] = True
+                continue
+            y0 = g * self.stripe_h
+            h = min(self.stripe_h, self.height - y0)
+            if h <= 0:
+                continue
+            if is_key:
+                payload = self._sps_pps_for(h) + payload
+                self._frame_num[n, g] = 1
+                self._idr_pic_id[n, g] = (self._idr_pic_id[n, g] + 1) % 16
+                self._need_idr[n, g] = False
+                self._static[n, g] = 0
+                self._painted[n, g] = False
+            else:
+                self._frame_num[n, g] = (self._frame_num[n, g] + 1) % 16
+            coded[n] += len(payload)
+            out[n].append(H264Stripe(
+                y_start=y0, width=self.width, height=h,
+                annexb=payload, is_key=is_key))
+        return out, coded
+
+    def encode_frames(self, frames) -> Tuple[List[List[H264Stripe]],
+                                             np.ndarray]:
+        """Synchronous dispatch + harvest (tests, simple callers)."""
+        return self.harvest(self.dispatch(frames))

@@ -173,11 +173,11 @@ class DataStreamingServer:
         #: one coordinator per display geometry, lazily built — a
         #: mismatched-resolution join gets its own bucket instead of a
         #: silent solo fallback (VERDICT r2 item 6)
-        self.mesh_coordinators: Dict[Tuple[int, int], Any] = {}
+        self.mesh_coordinators: Dict[Tuple[int, int, str], Any] = {}
         #: geometries whose coordinator construction failed — scoped per
         #: geometry so one bad bucket (e.g. a transient OOM at 4K) does
         #: not disable mesh batching for healthy buckets
-        self._mesh_failed_geoms: Set[Tuple[int, int]] = set()
+        self._mesh_failed_geoms: Set[Tuple[int, int, str]] = set()
         #: counters surfaced in the stats JSON so mesh fallbacks are
         #: observable, not silent
         self.mesh_stats = {"bucketed": 0, "solo_fallback": 0}
@@ -680,15 +680,17 @@ class DataStreamingServer:
         """Session facade onto the mesh coordinator when ``tpu_mesh`` is
         configured (BASELINE config 5); None → solo encoder pipeline.
 
-        Mesh batching covers the JPEG profile with server-wide quality
-        settings (SPMD uniformity); other profiles, mismatched geometry,
-        or slot exhaustion fall back to a solo encoder per display.
+        Mesh batching covers the JPEG and striped-H.264 profiles with
+        server-wide quality settings (SPMD uniformity); the full-frame
+        x264enc profile, mismatched geometry, or slot exhaustion fall
+        back to a solo encoder per display. Buckets are keyed by
+        (geometry, profile) — the SPMD program is profile-specific.
         """
         spec = str(self.settings.tpu_mesh)
         if not spec:
             return None
         profile = st.overrides.get("encoder", self.settings.encoder)
-        if profile != "jpeg":
+        if profile not in ("jpeg", "x264enc-striped"):
             return None
         if str(self.settings.watermark_path):
             # the mesh encoder has no watermark stage yet; a configured
@@ -697,7 +699,7 @@ class DataStreamingServer:
                 "tpu_mesh ignored for %s: watermark_path requires the solo "
                 "JPEG pipeline", st.display_id)
             return None
-        geom = (st.width, st.height)
+        geom = (st.width, st.height, profile)
         if geom in self._mesh_failed_geoms:
             self.mesh_stats["solo_fallback"] += 1
             return None
@@ -709,7 +711,7 @@ class DataStreamingServer:
                 self.mesh_stats["solo_fallback"] += 1
                 logger.warning(
                     "mesh batching: bucket limit reached; %s at %dx%d "
-                    "uses a solo encoder", st.display_id, *geom)
+                    "uses a solo encoder", st.display_id, *geom[:2])
                 return None
             try:
                 from ..parallel.coordinator import MeshEncodeCoordinator
@@ -717,15 +719,15 @@ class DataStreamingServer:
                 coord = MeshEncodeCoordinator(
                     spec, int(self.settings.tpu_sessions_per_chip),
                     st.width, st.height, settings=self.settings,
-                    framerate=fps)
+                    framerate=fps, profile=profile)
                 self.mesh_coordinators[geom] = coord
                 logger.info(
-                    "mesh batching: %s → %d session slots at %dx%d "
-                    "(bucket %d)", spec, coord.n_sessions, st.width,
-                    st.height, len(self.mesh_coordinators))
+                    "mesh batching: %s → %d %s session slots at %dx%d "
+                    "(bucket %d)", spec, coord.n_sessions, profile,
+                    st.width, st.height, len(self.mesh_coordinators))
             except Exception:
                 logger.exception(
-                    "mesh coordinator for %dx%d unavailable; that "
+                    "mesh coordinator for %dx%d (%s) unavailable; that "
                     "geometry uses solo encoders", *geom)
                 self._mesh_failed_geoms.add(geom)
                 self.mesh_stats["solo_fallback"] += 1

@@ -1,37 +1,36 @@
-"""Per-stage device-time attribution for the tpuenc H.264 path (config 2).
+"""Device-side cost attribution for the tpuenc H.264 path (config 2).
 
-VERDICT r2 item 1: the 5× fps gap against BASELINE config 2 (60 fps
-1080p H.264) was unattributed — this tool separates where a frame's time
-actually goes, so "lifts on PCIe" claims are measured, not asserted:
+Round-3 lesson (VERDICT r3 weak #2 + the round-2/3 tunnel notes): on the
+RPC-tunneled dev chip, per-stage chained-dispatch timings measure the
+degraded per-dispatch round trip (~12-65 ms/program after the first
+fetch), NOT device compute — the round-3 run of this tool reported a
+"full_step_ms" that was mostly transport. The only tunnel-resistant
+estimator is the **batch-size sweep**: time the batched scan program
+(dev.encode_frame_p_batch_rgb, one dispatch for B frames) at two batch
+sizes and take the slope,
 
-  * ``sync_floor_ms``   — cost of one trivial dispatch + host sync on this
-    transport (the tunnel's ~100 ms RPC floor; ~0 on PCIe). Every *timing*
-    below amortizes it by chaining N async dispatches per one sync.
-  * ``me_mc_ms``        — the fused exhaustive ME + MC scan alone
-    (ops/pallas_me.py me_mc_stripes, VMEM-resident kernel).
-  * ``pack_ms``         — block-sparse level pack alone (_pack_sparse).
-  * ``full_step_ms``    — the complete device program the product runs per
-    P frame (prepare_planes + ME/MC + transform/quant/recon + pack), i.e.
-    the tunnel-excluded device-side frame cost. ``device_fps`` = 1000/this.
-  * ``transform_ms``    — derived: full − ME/MC − pack (transform, quant,
-    reconstruction, damage select, color conversion).
-  * ``d2h_ms``          — wall time to fetch one typical sparse buffer
-    (transport-bound on the tunnel; the pipeline overlaps several).
-  * ``cavlc_ms``        — host entropy coding of one fetched frame.
-  * ``me_tflops``       — analytic FLOP count of the SAD search divided by
-    measured ME time (device-utilization estimate for the MXU portion).
+    device_ms_per_frame = (T(B2) - T(B1)) / (chain * (B2 - B1)),
 
-Shared-chip protocol: each timing is best-of-``repeats`` (the tunnel's
-timings swing ±40% with contention; the minimum is the least-contended
-estimate — BASELINE.md round-2 variance note).
+which cancels every fixed per-dispatch and per-fetch cost. Stage
+attribution comes from re-running the sweep with a stage stubbed out
+(``--attribute``): slope(full) - slope(without ME) ≈ ME's in-context
+cost, etc. Host CAVLC is timed directly (it is host work).
 
-Run: ``python tools/h264_stages.py [--frames N]`` → one JSON line.
+Outputs one JSON line:
+  device_ms_per_frame / device_fps  — tunnel-excluded device truth
+  dispatch_overhead_ms              — fixed cost per batch dispatch
+  fetch_floor_ms                    — one D2H round trip on this link
+  me_ms / pack_ms / transform_ms    — in-context stage slopes (--attribute)
+  me_tflops                         — analytic SAD FLOPs / measured ME time
+  cavlc_ms_frame                    — host entropy coding per frame
+  cavlc_scaling                     — CAVLC wall time at 1/2/4/8 pool threads
+
+Run: ``python tools/h264_stages.py [--frames N] [--attribute]``.
 """
 
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import os
 import sys
@@ -44,15 +43,47 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 W, H = 1920, 1080
 
 
-def _best_of(fn, repeats: int):
-    vals = []
-    for _ in range(repeats):
-        vals.append(fn())
-    return min(vals), vals
+def _sweep(enc, src, b1: int, b2: int, chain: int, reps: int):
+    """Slope + intercept of the batched program's wall time vs B."""
+    import jax.numpy as jnp
+
+    def run_chain(B):
+        frames = jnp.stack([src.next_frame() for _ in range(B)])
+        pends = enc.dispatch_batch(frames, fetch=False)     # compile
+        np.asarray(pends[-1].batch_heads[0, :64])           # real sync
+        best = 1e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(chain):
+                pends = enc.dispatch_batch(frames, fetch=False)
+            np.asarray(pends[-1].batch_heads[0, :64])       # one tiny fetch
+            best = min(best, (time.perf_counter() - t0) * 1000.0)
+        return best
+
+    floor = run_chain_floor(enc, src)
+    t1, t2 = run_chain(b1), run_chain(b2)
+    slope = (t2 - t1) / (chain * (b2 - b1))
+    per_dispatch = max(0.0, (t1 - floor) / chain - b1 * slope)
+    return slope, per_dispatch, floor, (t1, t2)
 
 
-def measure(frames: int = 12, repeats: int = 3, width: int = W,
-            height: int = H) -> dict:
+def run_chain_floor(enc, src):
+    """One tiny fetch with zero extra dispatches = the D2H round trip."""
+    import jax.numpy as jnp
+
+    frames = jnp.stack([src.next_frame() for _ in range(2)])
+    pends = enc.dispatch_batch(frames, fetch=False)
+    np.asarray(pends[-1].batch_heads[0, :64])
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(pends[-1].batch_heads[0, 64:128])
+        best = min(best, (time.perf_counter() - t0) * 1000.0)
+    return best
+
+
+def measure(width: int = W, height: int = H, b1: int = 6, b2: int = 12,
+            chain: int = 4, reps: int = 3, attribute: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -62,147 +93,123 @@ def measure(frames: int = 12, repeats: int = 3, width: int = W,
 
     enc = H264StripeEncoder(width, height)
     src = DeviceScrollSource(width, enc.pad_h)
-    S, sh = enc.n_stripes, enc.stripe_h
+    enc.encode_frame(src.next_frame())          # IDR + compiles
+    enc.encode_frame(src.next_frame())
 
-    def nxt():
-        return src.next_frame()
-
-    # ---- sync floor: a trivial program + one host sync ------------------
-    tiny = jax.jit(lambda x: x + 1)
-    x = jnp.zeros((8, 128), jnp.float32)
-    tiny(x).block_until_ready()
-
-    def run_floor():
-        t0 = time.perf_counter()
-        tiny(x).block_until_ready()
-        return (time.perf_counter() - t0) * 1000.0
-
-    sync_floor_ms, floor_runs = _best_of(run_floor, max(repeats, 5))
-
-    # ---- full device step (the product P-frame program) -----------------
-    # chain `frames` dispatches through the encoder's own state, then one
-    # sync: per-frame cost ≈ (total − sync floor) / frames
-    enc.encode_frame(nxt())          # IDR + compile
-    enc.encode_frame(nxt())          # P compile
-    pend = None
-
-    def run_full():
-        nonlocal pend
-        t0 = time.perf_counter()
-        for _ in range(frames):
-            pend = enc.dispatch(nxt(), fetch=False)
-        pend.flat16.block_until_ready()
-        return ((time.perf_counter() - t0) * 1000.0 - sync_floor_ms) / frames
-
-    full_step_ms, full_runs = _best_of(run_full, repeats)
-
-    # ---- fused ME/MC kernel alone ---------------------------------------
-    from selkies_tpu.ops.pallas_me import me_mc_stripes
-    y, cb, cr = dev.prepare_planes(nxt(), enc.pad_h, enc.pad_w)
-    ys = y.reshape(S, sh, enc.pad_w)
-    cbs = cb.reshape(S, sh // 2, enc.pad_w // 2)
-    crs = cr.reshape(S, sh // 2, enc.pad_w // 2)
-    me = functools.partial(me_mc_stripes, search=enc.search)
-    me(ys, ys, cbs, crs)[0].block_until_ready()    # compile
-
-    def run_me():
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(frames):
-            out = me(ys, ys, cbs, crs)
-        out[0].block_until_ready()
-        return ((time.perf_counter() - t0) * 1000.0 - sync_floor_ms) / frames
-
-    me_mc_ms, me_runs = _best_of(run_me, repeats)
-
-    # ---- sparse pack alone ----------------------------------------------
-    words = enc._stripe_words
-    rng = np.random.default_rng(0)
-    f16 = np.zeros((S, words), np.int16)
-    nz = rng.random((S, words)) < 0.02             # typical sparsity
-    f16[nz] = rng.integers(-40, 41, int(nz.sum()))
-    f16j = jnp.asarray(f16)
-    damage = jnp.ones((S,), bool)
-    pack = jax.jit(functools.partial(dev._pack_sparse, cap_frac=4))
-    pack(f16j, damage, damage).block_until_ready()
-
-    def run_pack():
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(frames):
-            out = pack(f16j, damage, damage)
-        out.block_until_ready()
-        return ((time.perf_counter() - t0) * 1000.0 - sync_floor_ms) / frames
-
-    pack_ms, pack_runs = _best_of(run_pack, repeats)
-
-    # ---- D2H of one typical sparse prefix -------------------------------
-    # distinct device arrays per read (a repeated read of the same array
-    # is host-cached and measures nothing), all computed before the timer
-    # so only the transfer is on the clock
-    buf = pack(f16j, damage, damage)
-    n_reads = max(repeats, 5)
-    prefixes = [(buf[:enc._sparse_guess] + jnp.uint8(i))
-                for i in range(n_reads)]
-    for p_ in prefixes:
-        p_.block_until_ready()
-    d2h_runs = []
-    for p_ in prefixes:
-        t0 = time.perf_counter()
-        np.asarray(p_)
-        d2h_runs.append((time.perf_counter() - t0) * 1000.0)
-    d2h_ms = min(d2h_runs)
-
-    # ---- host CAVLC for one frame's typical stripes ---------------------
-    # fetch first (off the clock), then time only the entropy coding
-    pend = enc.dispatch(nxt(), fetch=True)
-    host = np.asarray(pend.fetch)
-    t0 = time.perf_counter()
-    stripes = enc.harvest(pend, host=host)
-    cavlc_ms = (time.perf_counter() - t0) * 1000.0
-
-    # ---- analytic FLOPs of the SAD search (MXU utilization) -------------
-    n_offsets = (2 * enc.search + 1) ** 2
-    nby, nbx = sh // 16, enc.pad_w // 16
-    # per offset per stripe: abs-diff (sh*W) + two indicator matmuls
-    flops_per_offset = S * (2 * nby * sh * enc.pad_w      # A @ |d|
-                            + 2 * nby * enc.pad_w * nbx)  # (…) @ B
-    me_flops = n_offsets * flops_per_offset
-    me_tflops = me_flops / (me_mc_ms / 1000.0) / 1e12 if me_mc_ms > 0 else 0
-
-    transform_ms = max(0.0, full_step_ms - me_mc_ms - pack_ms)
-    return {
-        "sync_floor_ms": round(sync_floor_ms, 2),
-        "full_step_ms": round(full_step_ms, 2),
-        "me_mc_ms": round(me_mc_ms, 2),
-        "pack_ms": round(pack_ms, 2),
-        "transform_ms": round(transform_ms, 2),
-        "d2h_ms": round(d2h_ms, 2),
-        "cavlc_ms": round(cavlc_ms, 2),
-        "device_fps": round(1000.0 / full_step_ms, 2)
-        if full_step_ms > 0 else None,
-        "me_tflops": round(me_tflops, 2),
-        "n_offsets": n_offsets,
-        "stripes_out": len(stripes),
-        "spread": {
-            "full_step_ms": [round(v, 2) for v in full_runs],
-            "me_mc_ms": [round(v, 2) for v in me_runs],
-            "pack_ms": [round(v, 2) for v in pack_runs],
-            "sync_floor_ms": [round(v, 2) for v in floor_runs],
-            "d2h_ms": [round(v, 2) for v in d2h_runs],
-        },
+    slope, per_dispatch, floor, raw = _sweep(enc, src, b1, b2, chain, reps)
+    out = {
+        "device_ms_per_frame": round(slope, 2),
+        "device_fps": round(1000.0 / slope, 1) if slope > 0 else None,
+        "dispatch_overhead_ms": round(per_dispatch, 2),
+        "fetch_floor_ms": round(floor, 2),
+        "sweep_raw_ms": [round(v, 1) for v in raw],
+        "method": (
+            f"slope of one-dispatch batched scan at B={b1} vs B={b2} "
+            f"(chain={chain}, best-of-{reps}); cancels per-dispatch RPC"),
     }
+
+    if attribute:
+        # stage slopes by stubbing one stage at a time. A fresh encoder
+        # object does NOT bust the module-level jit cache — the batched
+        # program was already compiled with identical static args — so
+        # the caches are cleared around each stubbed variant (this is a
+        # standalone tool; recompiles are its cost, not the product's).
+        real_me, real_pack = dev.me_mc_stripes, dev._pack_sparse
+
+        def me_stub(cur, ref, ref_cb, ref_cr, search=12, interpret=None):
+            S, h, w = cur.shape
+            mv = jnp.zeros((S, h // 16, w // 16, 2), jnp.int32)
+            return mv, ref, ref_cb, ref_cr
+
+        def pack_stub(flat16, damage, update, cap_frac=4):
+            S, Wd = flat16.shape
+            _, n_cells, cap = dev.sparse_geometry(Wd, cap_frac)
+            total = 4 * S + S * (n_cells // 8) + S * cap * dev.CELL
+            return jnp.zeros((total,), jnp.uint8)
+
+        try:
+            jax.clear_caches()
+            dev.me_mc_stripes = me_stub
+            e2 = H264StripeEncoder(width, height)
+            s2 = DeviceScrollSource(width, e2.pad_h)
+            e2.encode_frame(s2.next_frame())
+            e2.encode_frame(s2.next_frame())
+            no_me, _, _, _ = _sweep(e2, s2, b1, b2, chain, reps)
+        finally:
+            dev.me_mc_stripes = real_me
+        try:
+            jax.clear_caches()
+            dev._pack_sparse = pack_stub
+            e3 = H264StripeEncoder(width, height)
+            s3 = DeviceScrollSource(width, e3.pad_h)
+            e3.encode_frame(s3.next_frame())
+            e3.encode_frame(s3.next_frame())
+            no_pack, _, _, _ = _sweep(e3, s3, b1, b2, chain, reps)
+        finally:
+            dev._pack_sparse = real_pack
+            jax.clear_caches()
+
+        me_ms = max(0.0, slope - no_me)
+        pack_ms = max(0.0, slope - no_pack)
+        out["me_ms"] = round(me_ms, 2)
+        out["pack_ms"] = round(pack_ms, 2)
+        out["transform_ms"] = round(max(0.0, slope - me_ms - pack_ms), 2)
+
+        # analytic SAD FLOPs (abs-diff+sums+indicator matmul) / ME time
+        S, sh = enc.n_stripes, enc.stripe_h
+        n_off = (2 * enc.search + 1) ** 2
+        nby, nbx = sh // 16, enc.pad_w // 16
+        flops = n_off * S * (2 * nby * sh * enc.pad_w
+                             + 2 * nby * enc.pad_w * nbx)
+        out["me_tflops"] = round(flops / (me_ms / 1000.0) / 1e12, 2) \
+            if me_ms > 0 else None
+
+    # host CAVLC: one frame fetched, then entropy-only timing; also its
+    # scaling over pool sizes (headroom for 4K / multi-session)
+    import concurrent.futures
+
+    import selkies_tpu.encoder.h264 as h264mod
+
+    pend = enc.dispatch(src.next_frame(), fetch=True)
+    host = np.asarray(pend.fetch)
+    scaling = {}
+    saved_pool = h264mod._POOL
+    try:
+        for workers in (1, 2, 4, 8):
+            pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="cavlc")
+            h264mod._POOL = pool
+            # re-encode the same fetched frame; harvest mutates
+            # frame_num state, so rewind it between timings
+            t0 = time.perf_counter()
+            stripes = enc.harvest(pend, host=host)
+            dt = (time.perf_counter() - t0) * 1000.0
+            scaling[workers] = round(dt, 2)
+            for st in enc.stripes:
+                st.frame_num = (st.frame_num - 1) % 16
+            pool.shutdown(wait=False)
+    finally:
+        h264mod._POOL = saved_pool
+    out["cavlc_ms_frame"] = scaling[8]
+    out["cavlc_scaling"] = scaling
+    out["stripes_out"] = len(stripes)
+    return out
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--frames", type=int, default=12)
-    ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--width", type=int, default=W)
     ap.add_argument("--height", type=int, default=H)
+    ap.add_argument("--b1", type=int, default=6)
+    ap.add_argument("--b2", type=int, default=12)
+    ap.add_argument("--chain", type=int, default=4)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--attribute", action="store_true",
+                    help="also slope-attribute ME/pack/transform (slow)")
     args = ap.parse_args()
-    out = measure(frames=args.frames, repeats=args.repeats,
-                  width=args.width, height=args.height)
+    out = measure(width=args.width, height=args.height, b1=args.b1,
+                  b2=args.b2, chain=args.chain, reps=args.repeats,
+                  attribute=args.attribute)
     print(json.dumps(out))
 
 
