@@ -190,8 +190,20 @@ def encode_picture_nals(out: dev.StripeEncodeOut, *, is_idr: bool,
 
 def encode_picture_nals_np(mv, luma, luma_dc, chroma_dc, chroma_ac, *,
                            is_idr: bool, mb_w: int, mb_h: int, qp: int,
-                           frame_num: int, idr_pic_id: int = 0) -> bytes:
-    """CAVLC over host-resident coefficient arrays (already fetched)."""
+                           frame_num: int, idr_pic_id: int = 0,
+                           deblock: bool = False) -> bytes:
+    """CAVLC over host-resident coefficient arrays (already fetched).
+
+    ``deblock`` writes disable_deblocking_filter_idc=0 into P slice
+    headers (decoder runs the in-loop filter). STAGED, default off: the
+    encoder's device reconstruction does not yet mirror the filter —
+    the spec's per-macroblock filtering order carries a 3×3-corner
+    sequential dependency that defeats the straightforward
+    all-vertical-then-all-horizontal vectorization, so an exact
+    TPU-shaped formulation (wavefront or corner-fixup) is round-5 work
+    (BASELINE.md "Quality vs x264" decision 2). Until then, enabling
+    this flag drifts encoder refs from decoder output.
+    """
     lib = cavlc_lib()
     if lib is None:
         raise RuntimeError("native CAVLC coder unavailable")
@@ -204,7 +216,7 @@ def encode_picture_nals_np(mv, luma, luma_dc, chroma_dc, chroma_ac, *,
         np.ascontiguousarray(luma_dc, np.int32),
         np.ascontiguousarray(chroma_dc, np.int32),
         np.ascontiguousarray(chroma_ac, np.int32),
-        buf, cap)
+        buf, cap, 1 if deblock else 0)
     if n < 0:
         raise RuntimeError("CAVLC output exceeded capacity")
     return bytes(buf[:n])

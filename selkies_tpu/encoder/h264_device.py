@@ -86,14 +86,47 @@ def _mb_unblocks(blocks: jnp.ndarray, h: int, w: int, mb: int = MB
     return v.reshape(h, w)
 
 
-def _encode_luma_residual(res_blocks, qp, intra):
+#: x264-style decimation weights per 4×4 coefficient position: the cost
+#: of a LONE |level|==1 coefficient there (x264 decimate_table4 indexed
+#: by the reverse-zigzag leading run, mapped back to (row, col)). High
+#: frequencies are expensive (they force coding every run before them),
+#: low frequencies nearly free. Clustered coefficients over-count with
+#: this per-position sum — i.e. the approximation only KEEPS more.
+_DECIMATE_W = np.array([[0, 0, 0, 0],
+                        [0, 0, 0, 1],
+                        [0, 0, 1, 2],
+                        [0, 1, 2, 3]], np.int32)
+
+
+def _decimate_score(z):
+    """Per-block x264-style decimation score; (..., 4, 4) → (...)."""
+    a = jnp.abs(z)
+    w = jnp.asarray(_DECIMATE_W)
+    # any |level|>1 prices the block out of decimation (score 9 each)
+    per = jnp.where(a > 1, 9, jnp.where(a == 1, w, 0))
+    return per.sum(axis=(-2, -1))
+
+
+def _encode_luma_residual(res_blocks, qp, intra, decimate: bool = False):
     """4×4 transform+quant and exact decoder-side reconstruction.
 
     res_blocks: (n, 16, 4, 4) int32 residual.
     Returns (levels, recon_res) — both (n, 16, 4, 4) int32.
+
+    ``decimate`` (inter only) drops a macroblock's whole luma residual
+    when its x264-style score is < 6 — the "single small coefficient"
+    noise that costs cbp+run bits but buys no visible quality (x264
+    x264_macroblock_probe_skip / decimate path). The round-4 quality
+    gate measured isolated ±1 coefficients as a dominant bit cost on
+    near-static desktop content. The zeroed levels feed the
+    reconstruction below, so encoder refs stay decoder-exact.
     """
     w = ht.forward_dct4(res_blocks)
     z = ht.quant4(w, qp, intra=intra)
+    if decimate and not intra:
+        mb_score = _decimate_score(z).sum(axis=-1)        # (n,)
+        keep = (mb_score >= 6)[:, None, None, None]
+        z = jnp.where(keep, z, 0)
     d = ht.dequant4(z, qp)
     r = ht.inverse_dct4(d)
     return z, r
@@ -118,11 +151,16 @@ def _encode_luma_i16(res_blocks, qp):
     return z_dc, z_ac, r
 
 
-def _encode_chroma(res_blocks, qpc, intra):
+def _encode_chroma(res_blocks, qpc, intra, decimate: bool = False):
     """Chroma path (always DC 2×2 Hadamard + AC blocks).
 
     res_blocks: (n, 4, 4, 4) one component, 4 4×4 blocks per MB (2×2 grid).
     Returns (z_dc (n,2,2), z_ac (n,4,4,4), recon_res (n,4,4,4)).
+
+    ``decimate`` drops the component's AC levels when their per-MB
+    score is ≤ 3 (x264 uses < 7 over both components combined; each
+    component separately at half that is the conservative split). DC
+    always survives — it carries the visible tint.
     """
     w = ht.forward_dct4(res_blocks)                    # (n,4,4,4)
     dc = w[..., 0, 0].reshape(-1, 2, 2)
@@ -131,6 +169,10 @@ def _encode_chroma(res_blocks, qpc, intra):
     d_dc = ht.dequant_dc2(z_dc, qpc)
     z_ac = ht.quant4(w, qpc, intra=intra)
     z_ac = z_ac.at[..., 0, 0].set(0)
+    if decimate and not intra:
+        score = _decimate_score(z_ac).sum(axis=-1)     # (n,)
+        keep = (score > 3)[:, None, None, None]
+        z_ac = jnp.where(keep, z_ac, 0)
     d = ht.dequant4(z_ac, qpc)
     d = d.at[..., 0, 0].set(d_dc.reshape(-1, 4))
     r = ht.inverse_dct4(d)
@@ -197,7 +239,7 @@ def encode_stripe_p_pred(y, cb, cr, mv_grid, pred_y, pred_cb, pred_cr,
     h, w = y.shape
 
     res_y = _mb_blocks(y.astype(jnp.int32) - pred_y.astype(jnp.int32))
-    z_l, r = _encode_luma_residual(res_y, qp, intra=False)
+    z_l, r = _encode_luma_residual(res_y, qp, intra=False, decimate=True)
     recon_y = _clip8(
         _mb_unblocks(r, h, w) + pred_y.astype(jnp.int32))
 
@@ -206,7 +248,8 @@ def encode_stripe_p_pred(y, cb, cr, mv_grid, pred_y, pred_cb, pred_cr,
     for plane, pred in ((cb, pred_cb), (cr, pred_cr)):
         res = _mb_blocks(plane.astype(jnp.int32) - pred.astype(jnp.int32),
                          mb=MB // 2)
-        zc_dc, zc_ac, rc = _encode_chroma(res, qpc, intra=False)
+        zc_dc, zc_ac, rc = _encode_chroma(res, qpc, intra=False,
+                                          decimate=True)
         outs_c.append((zc_dc, zc_ac))
         recons_c.append(_clip8(
             _mb_unblocks(rc, h // 2, w // 2, mb=MB // 2)

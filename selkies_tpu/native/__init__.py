@@ -122,7 +122,7 @@ def _register_cavlc(lib: ctypes.CDLL) -> None:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int,
         _i32p, _i32p, _i32p, _i32p, _i32p,
-        _u8p, ctypes.c_int64,
+        _u8p, ctypes.c_int64, ctypes.c_int,
     ]
     fn.restype = ctypes.c_int64
 

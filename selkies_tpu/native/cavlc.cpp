@@ -576,7 +576,7 @@ void skip_mv(const PicCtx& ctx, int mb, int* dy, int* dx) {
 // slice writers
 
 void write_slice_header(BitWriter& bw, bool idr, int first_mb, int qp,
-                        int frame_num, int idr_pic_id) {
+                        int frame_num, int idr_pic_id, int deblock_idc) {
   bw.ue(first_mb);
   bw.ue(idr ? 7 : 5);  // slice_type: I-all / P-all
   bw.ue(0);            // pps id
@@ -594,7 +594,11 @@ void write_slice_header(BitWriter& bw, bool idr, int first_mb, int qp,
     bw.put(0, 1);  // adaptive_ref_pic_marking_mode
   }
   bw.se(qp - 26);  // slice_qp_delta (pic_init_qp = 26)
-  bw.ue(1);        // disable_deblocking_filter_idc = 1 (off)
+  bw.ue(deblock_idc);  // disable_deblocking_filter_idc (1 = off)
+  if (deblock_idc != 1) {
+    bw.se(0);  // slice_alpha_c0_offset_div2
+    bw.se(0);  // slice_beta_offset_div2
+  }
 }
 
 }  // namespace
@@ -607,7 +611,7 @@ int64_t h264_encode_picture(
     int is_idr, int mb_w, int mb_h, int qp, int frame_num, int idr_pic_id,
     const int32_t* mv, const int32_t* luma, const int32_t* luma_dc,
     const int32_t* chroma_dc, const int32_t* chroma_ac,
-    uint8_t* out, int64_t cap) {
+    uint8_t* out, int64_t cap, int deblock) {
   PicCtx ctx;
   ctx.init(mb_w, mb_h);
   ctx.mv = mv;
@@ -625,7 +629,7 @@ int64_t h264_encode_picture(
     for (int mb = 0; mb < ctx.n_mb; mb++) ctx.slice_of[mb] = mb;
     for (int mb = 0; mb < ctx.n_mb; mb++) {
       bw.reset();
-      write_slice_header(bw, true, mb, qp, frame_num, idr_pic_id);
+      write_slice_header(bw, true, mb, qp, frame_num, idr_pic_id, 1);
       MbInfo info = analyze_mb(ctx, mb, true);
       // I_16x16: 1 + predMode(2=DC) + 4*cbp_chroma + 12*(cbp_luma==15)
       int mb_type = 1 + 2 + 4 * info.cbp_chroma +
@@ -641,7 +645,14 @@ int64_t h264_encode_picture(
     // single P slice
     for (int mb = 0; mb < ctx.n_mb; mb++) ctx.slice_of[mb] = 0;
     bw.reset();
-    write_slice_header(bw, false, 0, qp, frame_num, idr_pic_id);
+    // deblock=1 → disable_deblocking_filter_idc=0: the decoder runs the
+    // in-loop filter over the whole (single-slice) P picture, matching
+    // the device-side filter applied to the encoder's reference planes
+    // (encoder/deblock.py). IDR slices stay idc=1: per-MB slices would
+    // otherwise filter across slice boundaries after decode, and intra
+    // pictures are refreshed wholesale anyway.
+    write_slice_header(bw, false, 0, qp, frame_num, idr_pic_id,
+                       deblock ? 0 : 1);
 
     // decide skip per MB
     std::vector<MbInfo> infos(ctx.n_mb);

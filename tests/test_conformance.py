@@ -185,3 +185,76 @@ def test_h264_partial_last_stripe_decodes():
         i = s.y_start // enc.stripe_h
         ry, _, _ = enc.stripe_ref(i)
         np.testing.assert_array_equal(dy, ry[:s.height, :w])
+
+
+def test_deblock_enabled_slice_header_decodes():
+    """STAGED deblocking groundwork: a P slice written with
+    disable_deblocking_filter_idc=0 (+ the two offset fields) must
+    parse and decode in libavcodec, and the decoder's in-loop filter
+    must actually engage (pixels differ from the unfiltered stream).
+    The flag is off in the product until the device reconstruction
+    mirrors the filter (see encode_picture_nals_np docstring)."""
+    import numpy as np
+
+    from selkies_tpu.encoder import h264_device as dev
+    from selkies_tpu.encoder.h264 import (H264StripeEncoder,
+                                          encode_picture_nals_np)
+
+    # smooth content at a high QP: deblocking only engages where the
+    # step across a block edge is SMALLER than alpha(qp) — flat
+    # gradients with coarse quantization, not high-contrast noise
+    W, H = 128, 64
+    yy, xx = np.mgrid[0:H, 0:W].astype(np.float32)
+    f0 = np.clip(np.stack([96 + xx / 3 + yy / 5] * 3, -1),
+                 0, 255).astype(np.uint8)
+    f1 = np.clip(np.stack([96 + (xx + 2) / 3 + (yy + 7) / 5] * 3, -1),
+                 0, 255).astype(np.uint8)
+
+    def encode(deblock):
+        enc = H264StripeEncoder(W, H, stripe_height=64, qp=44)
+        out = []
+        for t, f in enumerate((f0, f1)):
+            p = enc.dispatch(f, fetch=True)
+            host = np.asarray(p.fetch)
+            if p.is_idr:
+                stripes = enc.harvest(p, host=host)
+                out.append(b"".join(s.annexb for s in stripes))
+                continue
+            # P frame: re-code the fetched levels with the flag
+            S = enc.n_stripes
+            row = np.asarray(p.flat16[0]).astype(np.int32)
+            parts, pos = [], 0
+            for shape, size in enc._shapes:
+                parts.append(row[pos:pos + size].reshape(shape))
+                pos += size
+            mv, luma, luma_dc, chroma_dc, chroma_ac = parts
+            nals = encode_picture_nals_np(
+                mv, luma, luma_dc, chroma_dc, chroma_ac,
+                is_idr=False, mb_w=enc.pad_w // 16,
+                mb_h=enc.stripe_h // 16, qp=44, frame_num=1,
+                deblock=deblock)
+            out.append(nals)
+        return out
+
+    plain = encode(False)
+    filtered = encode(True)
+    assert plain[0] == filtered[0]            # IDR untouched
+    assert plain[1] != filtered[1]            # P slice header differs
+
+    def decode(streams):
+        dec = conformance.ConformanceDecoder("h264", max_dim=256)
+        frames = []
+        for s in streams:
+            got = dec.decode(s)
+            if got is not None:
+                frames.append(got)
+        frames.extend(dec.flush())
+        dec.close()
+        return frames
+
+    fa = decode(plain)
+    fb = decode(filtered)
+    assert len(fa) == 2 and len(fb) == 2      # both streams fully decode
+    np.testing.assert_array_equal(fa[0][0], fb[0][0])   # IDR identical
+    # the in-loop filter engaged: P pictures differ between streams
+    assert not np.array_equal(fa[1][0], fb[1][0])
