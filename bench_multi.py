@@ -60,8 +60,21 @@ def bench_mesh() -> dict:
 
     frames = 0
     total_bytes = 0
+    d2h_bytes = 0
+    fetch_ms = []
     ticks = max(1, BENCH_FRAMES // n_sessions)
     from collections import deque
+
+    def harvest_timed(p):
+        # per-shard fetch truth (ISSUE 1 satellite — MULTICHIP files
+        # carried no transfer numbers): wall time until the dispatched
+        # tick's prefix is host-readable, and its aggregate byte size
+        nonlocal d2h_bytes
+        t0 = time.perf_counter()
+        p.prefix.block_until_ready()
+        fetch_ms.append((time.perf_counter() - t0) * 1000.0)
+        d2h_bytes += int(np.prod(p.prefix.shape)) * p.prefix.dtype.itemsize
+        return enc.harvest(p)
 
     start = time.perf_counter()
     pending = deque()
@@ -71,21 +84,28 @@ def bench_mesh() -> dict:
         batch = roll(batch)
         pending.append(enc.dispatch(batch))  # overlap: 2 steps in flight
         if len(pending) >= 3:
-            out, _bytes = enc.harvest(pending.popleft())
+            out, _bytes = harvest_timed(pending.popleft())
             frames += sum(1 for s in out if s)
             total_bytes += sum(len(st.jpeg) for s in out for st in s)
     while pending:
-        out, _bytes = enc.harvest(pending.popleft())
+        out, _bytes = harvest_timed(pending.popleft())
         frames += sum(1 for s in out if s)
         total_bytes += sum(len(st.jpeg) for s in out for st in s)
     elapsed = time.perf_counter() - start
     fps = frames / elapsed if elapsed > 0 else 0.0
+    fetch_sorted = sorted(fetch_ms) or [0.0]
     return {
         "mesh_aggregate_fps": round(fps, 2),
         "mesh_sessions": n_sessions,
         "mesh_devices": n_dev,
         "mesh_frames": frames,
         "mesh_mean_frame_kb": round(total_bytes / max(frames, 1) / 1024, 1),
+        "mesh_fetch_ms_p50": round(
+            fetch_sorted[len(fetch_sorted) // 2], 2),
+        "mesh_fetch_ms_p95": round(
+            fetch_sorted[min(len(fetch_sorted) - 1,
+                             int(len(fetch_sorted) * 0.95))], 2),
+        "mesh_d2h_bytes_per_frame": round(d2h_bytes / max(frames, 1)),
     }
 
 
@@ -158,6 +178,10 @@ def main() -> None:
         "solo_sessions": N_SESSIONS,
         "solo_aggregate_fps": round(fps, 2),
         "solo_frames": done,
+        "solo_d2h_bytes_per_frame": round(
+            sum(e.stats()["d2h_bytes_per_frame"] * max(e.stats()["frames"], 1)
+                for e, _, _ in sessions)
+            / max(sum(e.stats()["frames"] for e, _, _ in sessions), 1)),
         "elapsed_s": round(elapsed, 2),
         "mean_frame_kb": round(total_bytes / max(done, 1) / 1024, 1),
         **mesh,

@@ -20,6 +20,8 @@ Split of work (TPU-first, SURVEY.md §7 step 6):
 from __future__ import annotations
 
 import logging
+import os
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -27,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..native import cavlc_lib
+from . import device_cavlc as dcav
 from . import h264_device as dev
 
 logger = logging.getLogger("selkies_tpu.encoder.h264")
@@ -261,7 +264,8 @@ class H264StripeEncoder:
                  qp: int = 26, paint_over_qp: int = 18,
                  paint_over_trigger_frames: int = 15,
                  search: int = 12, fullframe: bool = False,
-                 cap_frac: int = 8) -> None:
+                 cap_frac: int = 8,
+                 entropy: Optional[str] = None) -> None:
         if width % 2 or height % 2:
             raise ValueError("frame dimensions must be even")
         if stripe_height % MB:
@@ -317,23 +321,59 @@ class H264StripeEncoder:
         self._cap_frac = cap_frac
         self._pad_words, self._n_cells, self._cap_cells = \
             dev.sparse_geometry(self._stripe_words, cap_frac)
-        self._fixed_bytes = 4 * self.n_stripes \
-            + self.n_stripes * (self._n_cells // 8)
-        self._buf_bytes = self._fixed_bytes \
-            + self.n_stripes * self._cap_cells * dev.CELL
-        self._sparse_guess = self._bucket(self._fixed_bytes + (64 << 10))
-        #: batch dispatches need a STABLE static prefix — an adaptive one
-        #: recompiles the (expensive) batched program on every bucket
-        #: move. Undershoot falls back to the exact flat16 rows and grows
-        #: the prefix (bounded recompiles). Sized to cover worst-case
-        #: full-damage content at streaming QPs (~1/20 of the pixel
-        #: count in sparse cells, measured on the scroll source).
-        self._batch_prefix = self._bucket(
-            self._fixed_bytes + max(96 << 10, self.pad_h * self.pad_w // 20))
-        #: small prefix for static/quiet content — most desktop frames
-        #: need only the fixed bitmap head, and shipping the worst-case
-        #: head every frame would cost 10-30x the D2H bytes
+
+        #: entropy tier for P frames (docs/entropy.md): "device" packs
+        #: bit-exact CAVLC payloads on TPU (encoder/device_cavlc.py) so
+        #: the fetch is the ~12 KB bitstream itself and steady state
+        #: needs no host entropy threads; "host" ships the block-sparse
+        #: levels and runs native CAVLC.  IDR and overflow stripes use
+        #: the host path in both modes.
+        if entropy is None:
+            entropy = os.environ.get("SELKIES_TPU_H264_ENTROPY", "device")
+        if entropy not in ("device", "host"):
+            raise ValueError(f"entropy must be device|host, got {entropy!r}")
+        self.entropy = entropy
+        #: fetch tiers: _batch_prefix must be a STABLE static prefix —
+        #: an adaptive one recompiles the (expensive) batched program on
+        #: every bucket move; undershoot falls back to the exact flat16
+        #: rows and grows it (bounded recompiles). _prefix_small serves
+        #: static/quiet content — shipping the worst-case head every
+        #: frame would cost 10-30x the D2H bytes of an idle desktop.
+        if entropy == "device":
+            self._cavlc_msb = dcav.default_max_stripe_bytes(
+                self.pad_w // MB, sh // MB)
+            self._fixed_bytes = dcav.HEAD_BYTES * self.n_stripes
+            self._buf_bytes = self._fixed_bytes \
+                + self.n_stripes * self._cavlc_msb
+            # CAVLC payloads run ~4-6x smaller than the sparse cells, so
+            # the fetch tiers shrink accordingly: full-damage 1080p
+            # scroll measures ~12.7 KB/frame of bitstream, so pixels/80
+            # (~26 KB at 1080p → the 32 KB bucket) leaves ~2.5x headroom
+            # before the undershoot fallback engages
+            self._sparse_guess = self._bucket(self._fixed_bytes + (16 << 10))
+            self._batch_prefix = self._bucket(
+                self._fixed_bytes
+                + max(24 << 10, self.pad_h * self.pad_w // 80))
+        else:
+            self._cavlc_msb = 0
+            self._fixed_bytes = 4 * self.n_stripes \
+                + self.n_stripes * (self._n_cells // 8)
+            self._buf_bytes = self._fixed_bytes \
+                + self.n_stripes * self._cap_cells * dev.CELL
+            self._sparse_guess = self._bucket(
+                self._fixed_bytes + (64 << 10))
+            # worst-case full-damage content at streaming QPs runs
+            # ~1/20 of the pixel count in sparse cells (scroll source)
+            self._batch_prefix = self._bucket(
+                self._fixed_bytes
+                + max(96 << 10, self.pad_h * self.pad_w // 20))
         self._prefix_small = self._bucket(self._fixed_bytes + 4096)
+
+        #: observability (ISSUE 1 satellite): host entropy wall time and
+        #: D2H re-read bytes, accumulated per harvested frame so the
+        #: pipeline / bench can report per-frame gauges
+        self.host_entropy_ms_total = 0.0
+        self.d2h_refetch_bytes_total = 0
 
     def _choose_prefix(self) -> int:
         """Pick between the two compiled head sizes from the adaptive
@@ -400,6 +440,24 @@ class H264StripeEncoder:
                     n_stripes=self.n_stripes, sh=self.stripe_h)
             pending_buf = None
             fetch_arr = flat16 if fetch else None
+        elif self.entropy == "device":
+            # on-device CAVLC: the fetch prefix is head + bit-exact
+            # P-slice payloads (device_cavlc.py); flat16 stays device-
+            # resident for overflow/IDR-resync re-reads
+            (buf, head, flat16, self._prev_y, self._prev_cb, self._prev_cr,
+             self._ref_y, self._ref_cb, self._ref_cr) = \
+                dev.encode_frame_p_cavlc_rgb(
+                    rgb, self._prev_y, self._prev_cb, self._prev_cr,
+                    self._ref_y, self._ref_cb, self._ref_cr,
+                    jnp.asarray(paint, jnp.int32),
+                    jnp.int32(self.qp), jnp.int32(self.paint_over_qp),
+                    pad_h=self.pad_h, pad_w=self.pad_w,
+                    n_stripes=self.n_stripes, sh=self.stripe_h,
+                    search=self.search,
+                    max_stripe_bytes=self._cavlc_msb,
+                    prefix=self._choose_prefix(), me=dev._me_backend())
+            pending_buf = buf
+            fetch_arr = head if fetch else None
         else:
             # the whole per-frame program — planes, encode, pack, and the
             # fetch-prefix slice — is ONE dispatch (RPC-attached devices
@@ -427,6 +485,7 @@ class H264StripeEncoder:
         return _H264Pending(fetch=fetch_arr, flat16=flat16, is_idr=is_idr,
                             paint=paint, qp=qp_arr, buf=pending_buf,
                             head=head,
+                            cavlc=(not is_idr and self.entropy == "device"),
                             head_len=0 if is_idr else int(head.shape[0]))
 
     def dispatch_batch(self, rgbs, fetch: bool = True
@@ -459,19 +518,35 @@ class H264StripeEncoder:
                     st.painted_over = True
         qps = np.where(paints != 0, self.paint_over_qp, self.qp)
         prefix = self._choose_prefix()
-        (heads, flat16s, self._prev_y, self._prev_cb, self._prev_cr,
-         self._ref_y, self._ref_cb, self._ref_cr) = \
-            dev.encode_frame_p_batch_rgb(
-                jnp.asarray(rgbs),
-                self._prev_y, self._prev_cb, self._prev_cr,
-                self._ref_y, self._ref_cb, self._ref_cr,
-                jnp.asarray(paints, jnp.int32),
-                jnp.full((B,), self.qp, jnp.int32),
-                jnp.int32(self.paint_over_qp),
-                pad_h=self.pad_h, pad_w=self.pad_w,
-                n_stripes=self.n_stripes, sh=self.stripe_h,
-                search=self.search, prefix=prefix,
-                cap_frac=self._cap_frac, me=dev._me_backend())
+        if self.entropy == "device":
+            (heads, flat16s, self._prev_y, self._prev_cb, self._prev_cr,
+             self._ref_y, self._ref_cb, self._ref_cr) = \
+                dev.encode_frame_p_batch_cavlc_rgb(
+                    jnp.asarray(rgbs),
+                    self._prev_y, self._prev_cb, self._prev_cr,
+                    self._ref_y, self._ref_cb, self._ref_cr,
+                    jnp.asarray(paints, jnp.int32),
+                    jnp.full((B,), self.qp, jnp.int32),
+                    jnp.int32(self.paint_over_qp),
+                    pad_h=self.pad_h, pad_w=self.pad_w,
+                    n_stripes=self.n_stripes, sh=self.stripe_h,
+                    search=self.search,
+                    max_stripe_bytes=self._cavlc_msb,
+                    prefix=prefix, me=dev._me_backend())
+        else:
+            (heads, flat16s, self._prev_y, self._prev_cb, self._prev_cr,
+             self._ref_y, self._ref_cb, self._ref_cr) = \
+                dev.encode_frame_p_batch_rgb(
+                    jnp.asarray(rgbs),
+                    self._prev_y, self._prev_cb, self._prev_cr,
+                    self._ref_y, self._ref_cb, self._ref_cr,
+                    jnp.asarray(paints, jnp.int32),
+                    jnp.full((B,), self.qp, jnp.int32),
+                    jnp.int32(self.paint_over_qp),
+                    pad_h=self.pad_h, pad_w=self.pad_w,
+                    n_stripes=self.n_stripes, sh=self.stripe_h,
+                    search=self.search, prefix=prefix,
+                    cap_frac=self._cap_frac, me=dev._me_backend())
         if fetch:
             heads.copy_to_host_async()
         cache: Dict[str, np.ndarray] = {}   # shared host copy of heads
@@ -479,7 +554,57 @@ class H264StripeEncoder:
             fetch=None, flat16=None, is_idr=False, paint=paints[b],
             qp=qps[b], batch_heads=heads, batch_flat16=flat16s,
             batch_index=b, head_len=prefix,
+            cavlc=(self.entropy == "device"),
             batch_cache=cache) for b in range(B)]
+
+    def _recover_undershoot(self, p: "_H264Pending", host, needed: int,
+                            ovf: np.ndarray, damage: np.ndarray):
+        """Prediction-miss recovery shared by the sparse and device-CAVLC
+        transfers.  Single-frame dispatches re-read the right bucket from
+        the full device buffer; batch dispatches keep no full buffer, so
+        every emitting stripe falls back to the exact flat16 rows and the
+        pinned batch prefix grows (bucketed → bounded recompiles)."""
+        if needed > len(host):
+            if p.buf is not None:
+                full = p.buf[:self._bucket(needed)]
+                full.copy_to_host_async()
+                host = np.asarray(full)
+                self.d2h_refetch_bytes_total += host.nbytes
+            else:
+                ovf = ovf | damage | (p.paint != 0)
+                if len(host) >= self._batch_prefix:
+                    # undershoot at the LARGE prefix: worst-case head
+                    # really is bigger — grow it. An undershoot at the
+                    # small tier just means the scene got busy; the
+                    # guess below re-tiers it.
+                    self._batch_prefix = min(
+                        self._buf_bytes,
+                        self._bucket(needed + needed // 2))
+        self._sparse_guess = self._bucket(
+            max(needed + needed // 2, self._fixed_bytes + 4096))
+        return host, ovf
+
+    def _refetch_overflow_rows(self, p: "_H264Pending", damage, ovf):
+        """Exact flat16 re-reads for overflow stripes, all started before
+        any blocking (rare: |level| beyond the packed range)."""
+        if p.flat16 is None and p.batch_flat16 is not None:
+            p.flat16 = p.batch_flat16[p.batch_index]
+        refetch = {}
+        need_rows = [i for i in range(self.n_stripes)
+                     if ovf[i] and (damage[i] or p.paint[i])]
+        if len(need_rows) > 2:
+            # whole-frame fallback (batch undershoot): ONE read of the
+            # exact levels instead of a per-stripe RPC each
+            rows_host = np.asarray(p.flat16)
+            self.d2h_refetch_bytes_total += rows_host.nbytes
+            refetch = {i: rows_host[i] for i in need_rows}
+        else:
+            for i in need_rows:
+                sl = p.flat16[i]
+                sl.copy_to_host_async()
+                refetch[i] = sl
+                self.d2h_refetch_bytes_total += 2 * self._stripe_words
+        return refetch
 
     def harvest(self, p: "_H264Pending",
                 host: Optional[np.ndarray] = None) -> List[H264Stripe]:
@@ -494,13 +619,27 @@ class H264StripeEncoder:
                 host = p.batch_cache["heads"][p.batch_index]
             else:
                 host = np.asarray(p.fetch)
+        S = self.n_stripes
+        t_bits = base_words = None
         if p.is_idr:
             levels16 = host
-            damage = np.ones(self.n_stripes, bool)
-            ovf = np.zeros(self.n_stripes, bool)
+            damage = np.ones(S, bool)
+            ovf = np.zeros(S, bool)
+        elif p.cavlc:
+            # device-CAVLC transfer: head + bit-exact slice payloads
+            levels16 = None
+            t_bits, base_words, damage, ovf = dcav.parse_cavlc_head(host, S)
+            # mirror the device's per-stripe word clip: an overflowing
+            # stripe records its unclipped t_bits but compacts at most V
+            # words, and an unclipped estimate here would force a
+            # full-buffer refetch exactly on busy content
+            wc = np.minimum((t_bits + 31) // 32, self._cavlc_msb // 4)
+            needed = self._fixed_bytes + 4 * int(base_words[-1] + wc[-1])
+            host, ovf = self._recover_undershoot(p, host, needed,
+                                                 ovf, damage)
+            refetch = self._refetch_overflow_rows(p, damage, ovf)
         else:
             levels16 = None
-            S = self.n_stripes
             head = host[:4 * S].reshape(S, 4)
             counts = head[:, 0].astype(np.int64) \
                 + (head[:, 1].astype(np.int64) << 8)
@@ -508,51 +647,13 @@ class H264StripeEncoder:
             ovf = head[:, 3] != 0
             used = np.minimum(counts, self._cap_cells) * dev.CELL
             needed = self._fixed_bytes + int(used.sum())
-            if needed > len(host):
-                if p.buf is not None:
-                    # guessed prefix undershot: one more fetch of the
-                    # right bucket (and remember the level next frame)
-                    full = p.buf[:self._bucket(needed)]
-                    full.copy_to_host_async()
-                    host = np.asarray(full)
-                else:
-                    # batch dispatch keeps no full sparse buffer; the
-                    # exact flat16 rows recover every emitting stripe,
-                    # and the pinned batch prefix grows (bucketed, so
-                    # recompiles are bounded) so high-entropy content
-                    # doesn't pay this cliff on every future batch
-                    ovf = ovf | damage | (p.paint != 0)
-                    if len(host) >= self._batch_prefix:
-                        # undershoot at the LARGE prefix: worst-case head
-                        # really is bigger — grow it (bounded recompiles).
-                        # An undershoot at the small tier just means the
-                        # scene got busy; the guess below re-tiers it.
-                        self._batch_prefix = min(
-                            self._buf_bytes,
-                            self._bucket(needed + needed // 2))
-            self._sparse_guess = self._bucket(
-                max(needed + needed // 2, self._fixed_bytes + 4096))
+            host, ovf = self._recover_undershoot(p, host, needed,
+                                                 ovf, damage)
             bitmaps = host[4 * S:self._fixed_bytes] \
                 .reshape(S, self._n_cells // 8)
             starts = np.concatenate(
                 [[0], np.cumsum(used)[:-1]]) + self._fixed_bytes
-            # exact re-reads for clipped stripes, all started before any
-            # blocks (rare: |level| > 127 at streaming QPs)
-            if p.flat16 is None and p.batch_flat16 is not None:
-                p.flat16 = p.batch_flat16[p.batch_index]
-            refetch = {}
-            need_rows = [i for i in range(self.n_stripes)
-                         if ovf[i] and (damage[i] or p.paint[i])]
-            if len(need_rows) > 2:
-                # whole-frame fallback (batch undershoot): ONE read of
-                # the exact levels instead of a per-stripe RPC each
-                rows_host = np.asarray(p.flat16)
-                refetch = {i: rows_host[i] for i in need_rows}
-            else:
-                for i in need_rows:
-                    sl = p.flat16[i]
-                    sl.copy_to_host_async()
-                    refetch[i] = sl
+            refetch = self._refetch_overflow_rows(p, damage, ovf)
 
         out: List[H264Stripe] = []
         mb_w = self.pad_w // MB
@@ -576,6 +677,14 @@ class H264StripeEncoder:
             if not emit:
                 continue
 
+            if not p.is_idr and p.cavlc and not ovf[i]:
+                # device already entropy-coded this stripe: the host job
+                # is header/exp-Golomb glue only (no per-MB work)
+                pb, nbits = dcav.payload_slice(host, S, base_words,
+                                               t_bits, i)
+                jobs.append((i, st, is_key, int(p.qp[i]),
+                             ("bits", pb, nbits)))
+                continue
             if p.is_idr:
                 row = levels16[i].astype(np.int32)
             elif ovf[i]:
@@ -596,11 +705,15 @@ class H264StripeEncoder:
                 pos += size
             mv, luma, luma_dc, chroma_dc, chroma_ac = parts
             jobs.append((i, st, is_key, int(p.qp[i]),
-                         (mv, luma, luma_dc, chroma_dc, chroma_ac)))
+                         ("levels", mv, luma, luma_dc, chroma_dc,
+                          chroma_ac)))
 
         def run_one(job):
-            i, st, is_key, qp, arrays = job
-            mv, luma, luma_dc, chroma_dc, chroma_ac = arrays
+            i, st, is_key, qp, work = job
+            if work[0] == "bits":
+                _, pb, nbits = work
+                return dcav.assemble_p_slice(pb, nbits, qp, st.frame_num)
+            _, mv, luma, luma_dc, chroma_dc, chroma_ac = work
             if is_key:
                 nals = encode_picture_nals_np(
                     mv, luma, luma_dc, chroma_dc, chroma_ac,
@@ -620,10 +733,13 @@ class H264StripeEncoder:
 
         # the C coder releases the GIL: stripes entropy-code in parallel
         # (pixelflux does the same with per-stripe C++ threads)
+        t_entropy0 = time.perf_counter()
         if len(jobs) > 1:
             payloads = list(_entropy_pool().map(safe_one, jobs))
         else:
             payloads = [safe_one(job) for job in jobs]
+        self.host_entropy_ms_total += \
+            (time.perf_counter() - t_entropy0) * 1000.0
         for job, payload in zip(jobs, payloads):
             i, st, is_key, qp, _ = job
             if isinstance(payload, Exception):
@@ -679,5 +795,6 @@ class _H264Pending:
     batch_flat16: object = None     # (B, S, words) exact levels
     batch_index: int = 0
     batch_cache: Optional[Dict] = None  # shared host copy across the batch
+    cavlc: bool = False             # buffer holds device-CAVLC payloads
 
 

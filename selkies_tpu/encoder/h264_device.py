@@ -553,6 +553,90 @@ def encode_frame_p_rgb(rgb, prev_y, prev_cb, prev_cr,
             new_ref_y, new_ref_cb, new_ref_cr)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("pad_h", "pad_w", "n_stripes", "sh",
+                                    "search", "max_stripe_bytes", "prefix",
+                                    "me"),
+                   donate_argnames=("prev_y", "prev_cb", "prev_cr",
+                                    "ref_y", "ref_cb", "ref_cr"))
+def encode_frame_p_cavlc_rgb(rgb, prev_y, prev_cb, prev_cr,
+                             ref_y, ref_cb, ref_cr, paint, qp, paint_qp,
+                             *, pad_h: int, pad_w: int, n_stripes: int,
+                             sh: int, search: int = SEARCH,
+                             max_stripe_bytes: int = 0, prefix: int = 0,
+                             me: str = "pallas"):
+    """P encode with ON-DEVICE CAVLC: the whole per-frame program — planes,
+    damage, ME/MC, transform/quant/recon, entropy coding, and the
+    fetch-prefix slice — in ONE dispatch.  The host fetches per-stripe
+    bit-exact P-slice payloads (encoder/device_cavlc.py) instead of the
+    block-sparse level buffer, shrinking the named D2H bottleneck to the
+    actual bitstream size; flat16 stays on device for overflow/resync."""
+    from . import device_cavlc as dcav
+
+    y, cb, cr = prepare_planes(rgb, pad_h, pad_w)
+    enc, damage, update, new_ref_y, new_ref_cb, new_ref_cr = _frame_p_core(
+        y, cb, cr, prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr,
+        paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search,
+        me=me)
+    flat16, _ = _pack_levels(enc, damage, update)
+    S = n_stripes
+    buf = dcav.pack_p_frame(
+        enc.mv.reshape(S, -1, 2),
+        enc.luma.reshape(S, -1, 16, 4, 4),
+        enc.chroma_dc.reshape(S, -1, 2, 2, 2),
+        enc.chroma_ac.reshape(S, -1, 2, 4, 4, 4),
+        damage, update, mb_w=pad_w // MB, mb_h=sh // MB,
+        max_stripe_bytes=max_stripe_bytes)
+    head = buf[:prefix] if prefix else buf
+    return (buf, head, flat16, y, cb, cr,
+            new_ref_y, new_ref_cb, new_ref_cr)
+
+
+#: no donation — see encode_frame_p_batch_rgb
+@functools.partial(jax.jit,
+                   static_argnames=("pad_h", "pad_w", "n_stripes", "sh",
+                                    "search", "max_stripe_bytes", "prefix",
+                                    "me"))
+def encode_frame_p_batch_cavlc_rgb(rgbs, prev_y, prev_cb, prev_cr,
+                                   ref_y, ref_cb, ref_cr, paints, qps,
+                                   paint_qp, *, pad_h: int, pad_w: int,
+                                   n_stripes: int, sh: int,
+                                   search: int = SEARCH,
+                                   max_stripe_bytes: int = 0,
+                                   prefix: int = 0, me: str = "pallas"):
+    """B sequential P frames with on-device CAVLC in ONE program (the
+    reference chain rides a lax.scan exactly like
+    :func:`encode_frame_p_batch_rgb`); heads are per-frame fetch-prefix
+    slices of the CAVLC buffer."""
+    from . import device_cavlc as dcav
+
+    S = n_stripes
+
+    def step(carry, xs):
+        prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr = carry
+        rgb, paint, qp = xs
+        y, cb, cr = prepare_planes(rgb, pad_h, pad_w)
+        enc, damage, update, nry, nrcb, nrcr = _frame_p_core(
+            y, cb, cr, prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr,
+            paint, qp, paint_qp, n_stripes=n_stripes, sh=sh, search=search,
+            me=me)
+        flat16, _ = _pack_levels(enc, damage, update)
+        buf = dcav.pack_p_frame(
+            enc.mv.reshape(S, -1, 2),
+            enc.luma.reshape(S, -1, 16, 4, 4),
+            enc.chroma_dc.reshape(S, -1, 2, 2, 2),
+            enc.chroma_ac.reshape(S, -1, 2, 4, 4, 4),
+            damage, update, mb_w=pad_w // MB, mb_h=sh // MB,
+            max_stripe_bytes=max_stripe_bytes)
+        head = buf[:prefix] if prefix else buf
+        return (y, cb, cr, nry, nrcb, nrcr), (head, flat16)
+
+    carry0 = (prev_y, prev_cb, prev_cr, ref_y, ref_cb, ref_cr)
+    (ly, lcb, lcr, nry, nrcb, nrcr), (heads, flat16s) = jax.lax.scan(
+        step, carry0, (rgbs, paints, qps))
+    return heads, flat16s, ly, lcb, lcr, nry, nrcb, nrcr
+
+
 @functools.partial(jax.jit, static_argnames=("pad_h", "pad_w",
                                              "n_stripes", "sh"),
                    donate_argnames=("prev_y", "prev_cb", "prev_cr",

@@ -79,7 +79,7 @@ class PipelinedJpegEncoder:
     """
 
     def __init__(self, base: JpegStripeEncoder, depth: int = 8,
-                 fetch_group: int = 1) -> None:
+                 fetch_group: int = 1, metrics=None) -> None:
         if base.entropy != "device":
             raise ValueError("pipelining requires entropy='device'")
         self.base = base
@@ -91,6 +91,29 @@ class PipelinedJpegEncoder:
         self._seq = 0
         self._meta_words = META_WORDS_PER_STRIPE * base.n_stripes
         self._guess = base._packer.bucket_words(8192)
+        #: D2H / host-entropy accounting (observability/metrics.py gauges
+        #: d2h_bytes_per_frame + host_entropy_ms_per_frame; bench.py
+        #: emits both so the fetch-bottleneck claim stays measured)
+        self.metrics = metrics
+        self.d2h_bytes_total = 0
+        self.host_entropy_ms_total = 0.0
+        self.frames_completed = 0
+
+    def stats(self) -> dict:
+        """Per-frame transfer/host-entropy gauges over the run so far."""
+        n = max(1, self.frames_completed)
+        return {
+            "frames": self.frames_completed,
+            "d2h_bytes_per_frame": self.d2h_bytes_total / n,
+            "host_entropy_ms_per_frame": self.host_entropy_ms_total / n,
+        }
+
+    def _publish_metrics(self) -> None:
+        if self.metrics is not None and self.frames_completed:
+            st = self.stats()
+            self.metrics.set_d2h_bytes_per_frame(st["d2h_bytes_per_frame"])
+            self.metrics.set_host_entropy_ms_per_frame(
+                st["host_entropy_ms_per_frame"])
 
     @property
     def n_inflight(self) -> int:
@@ -196,6 +219,7 @@ class PipelinedJpegEncoder:
                 return False
             if item.group.host is None:
                 item.group.host = np.asarray(item.group.arr)
+                self.d2h_bytes_total += item.group.host.nbytes
             stride = item.group.stride
             buf = item.group.host[item.group_index * stride:
                                   (item.group_index + 1) * stride]
@@ -223,18 +247,24 @@ class PipelinedJpegEncoder:
             if not block and not item.refetch.is_ready():
                 return False
             item.words_np = np.asarray(item.refetch)
+            self.d2h_bytes_total += item.words_np.nbytes
         return True
 
     def _finish(self, item: _InFlight) -> List[StripeOutput]:
         b = self.base
+        self.frames_completed += 1
         nbytes_np, base_np, ovf_np = item.meta
         emit, is_paint = item.emit, item.is_paint
         if not emit.any() or item.words_np is None:
             return []
+        t0 = time.monotonic()
         scans = b._scans_from_packed(
             item.words_np, base_np, nbytes_np, ovf_np,
             emit, item.yq, item.cbq, item.crq)
-        return b._assemble(emit, is_paint, scans)
+        out = b._assemble(emit, is_paint, scans)
+        self.host_entropy_ms_total += (time.monotonic() - t0) * 1000.0
+        self._publish_metrics()
+        return out
 
     def _drain_one(self) -> Tuple[int, List[StripeOutput]]:
         item = self._inflight.popleft()
@@ -381,10 +411,17 @@ class PipelinedH264Encoder:
 
     def __init__(self, base, depth: int = 8, fetch_group: int = 4,
                  batch: int = 1,
-                 batch_deadline_s: Optional[float] = None) -> None:
+                 batch_deadline_s: Optional[float] = None,
+                 metrics=None) -> None:
         self.base = base
         self.depth = depth
         self.fetch_group = max(1, fetch_group)
+        #: transfer accounting for the d2h_bytes_per_frame /
+        #: host_entropy_ms_per_frame gauges (host-entropy time and
+        #: refetch bytes accumulate on the base encoder in harvest)
+        self.metrics = metrics
+        self.d2h_bytes_total = 0
+        self.frames_completed = 0
         #: frames encoded per device dispatch (dev.encode_frame_p_batch_rgb)
         #: — RPC-attached transports pay per dispatch, so batch>1 divides
         #: that cost; PCIe deployments keep 1 (no added latency)
@@ -408,6 +445,28 @@ class PipelinedH264Encoder:
     @property
     def n_inflight(self) -> int:
         return len(self._inflight)
+
+    def stats(self) -> dict:
+        """Per-frame transfer/host-entropy gauges over the run so far.
+        D2H counts grouped head fetches, solo IDR flat16 reads, and the
+        base encoder's undershoot/overflow re-reads; entropy ms is the
+        base harvest's host coding+glue wall time."""
+        n = max(1, self.frames_completed)
+        d2h = self.d2h_bytes_total \
+            + getattr(self.base, "d2h_refetch_bytes_total", 0)
+        ems = getattr(self.base, "host_entropy_ms_total", 0.0)
+        return {
+            "frames": self.frames_completed,
+            "d2h_bytes_per_frame": d2h / n,
+            "host_entropy_ms_per_frame": ems / n,
+        }
+
+    def _publish_metrics(self) -> None:
+        if self.metrics is not None and self.frames_completed:
+            st = self.stats()
+            self.metrics.set_d2h_bytes_per_frame(st["d2h_bytes_per_frame"])
+            self.metrics.set_host_entropy_ms_per_frame(
+                st["host_entropy_ms_per_frame"])
 
     def request_keyframe(self) -> None:
         self.base.request_keyframe()
@@ -547,6 +606,7 @@ class PipelinedH264Encoder:
                 return False
             if item.host is None:
                 item.host = np.asarray(p.flat16)
+                self.d2h_bytes_total += item.host.nbytes
             return True
         if item.group is None:
             if not block:
@@ -556,6 +616,7 @@ class PipelinedH264Encoder:
             return False
         if item.group.host is None:
             item.group.host = np.asarray(item.group.arr)
+            self.d2h_bytes_total += item.group.host.nbytes
         if item.group.host.ndim == 2:      # batched dispatch: (B, prefix)
             item.host = item.group.host[item.group_index]
         elif item.group.offsets:
@@ -572,7 +633,10 @@ class PipelinedH264Encoder:
         # complete strictly in submission order (deque head first)
         item = self._inflight.popleft()
         self._advance(item, block=True)
-        return item.seq, self.base.harvest(item.pending, host=item.host)
+        out = self.base.harvest(item.pending, host=item.host)
+        self.frames_completed += 1
+        self._publish_metrics()
+        return item.seq, out
 
     def poll(self, flush_partial: bool = True) -> List[Tuple[int, list]]:
         """Harvest completed frames in order; see PipelinedJpegEncoder.poll
@@ -591,6 +655,8 @@ class PipelinedH264Encoder:
             item = self._inflight.popleft()
             out.append((item.seq,
                         self.base.harvest(item.pending, host=item.host)))
+            self.frames_completed += 1
+        self._publish_metrics()
         return out
 
     def flush(self) -> List[Tuple[int, list]]:

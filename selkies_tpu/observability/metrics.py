@@ -53,6 +53,18 @@ class Metrics:
             "tpuenc_frame_bytes", "Encoded bytes per frame",
             buckets=(1e3, 5e3, 2e4, 5e4, 1e5, 2.5e5, 1e6, float("inf")),
             registry=self.registry)
+        # ISSUE 1: the H.264 bottleneck claims (D2H transfer size, host
+        # entropy cost per session) must be measured, not inferred — the
+        # pipelined encoders record these per frame
+        self.d2h_bytes_per_frame = Gauge(
+            "tpuenc_d2h_bytes_per_frame", "Device-to-host bytes fetched "
+            "per encoded frame (heads, payloads, and overflow re-reads)",
+            registry=self.registry)
+        self.host_entropy_ms_per_frame = Gauge(
+            "tpuenc_host_entropy_ms_per_frame", "Host-side entropy-coding "
+            "wall time per frame (native CAVLC / overflow fallbacks; ~0 "
+            "when the device entropy tiers carry steady state)",
+            registry=self.registry)
         self.clients = Gauge("connected_clients", "WebSocket clients",
                              registry=self.registry)
         self.backpressured = Gauge(
@@ -87,6 +99,14 @@ class Metrics:
         if HAVE_PROM:
             self.encode_ms.observe(ms)
             self.frame_bytes.observe(nbytes)
+
+    def set_d2h_bytes_per_frame(self, nbytes: float) -> None:
+        if HAVE_PROM:
+            self.d2h_bytes_per_frame.set(nbytes)
+
+    def set_host_entropy_ms_per_frame(self, ms: float) -> None:
+        if HAVE_PROM:
+            self.host_entropy_ms_per_frame.set(ms)
 
     def set_clients(self, n: int) -> None:
         if HAVE_PROM:

@@ -40,6 +40,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .motion import _offsets, pad_replicate
 
+#: jax ≥ 0.5 renamed TPUCompilerParams → CompilerParams; accept either so
+#: the interpret-mode CPU path keeps working on older runtimes
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 MB = 16
 
 
@@ -263,7 +268,7 @@ def me_mc_stripes(cur, ref, ref_cb, ref_cr, *, search: int = 12,
         # 4K stripes (w=3840) need ~18 MB of scoped VMEM (the rolled
         # int32 window + the indicator constants); the default 16 MB
         # scope is conservative, not the physical limit
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(ranks, cur, ref_pad, cbp, crp)

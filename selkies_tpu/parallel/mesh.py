@@ -20,6 +20,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..encoder.jpeg import _encode_body
 
+#: jax ≥ 0.5 promoted shard_map out of experimental; accept either spelling
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - older runtimes
+    from jax.experimental.shard_map import shard_map
+
 
 def make_mesh(
     devices=None,
@@ -95,7 +101,7 @@ def make_batched_step(mesh: Mesh, stripe_h: int):
         total_bits = jax.lax.psum(session_bits.sum(), "session")
         return yq, cbq, crq, damage, new_prev, session_bits, total_bits
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(
@@ -174,7 +180,7 @@ def make_batched_entropy_step(mesh: Mesh, pad_h: int, pad_w: int,
         packed = jnp.concatenate([head, words], axis=1)[:, None, :]
         return (packed, new_prev, yq, cbq, crq, session_bytes, total_bytes)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(

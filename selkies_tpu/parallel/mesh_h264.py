@@ -35,9 +35,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..encoder import device_cavlc as dcav
 from ..encoder import h264_device as dev
 from ..encoder.h264 import H264Stripe, encode_picture_nals_np, make_pps, make_sps
 from ..encoder.h264 import _entropy_pool
+from .mesh import shard_map
 
 logger = logging.getLogger("selkies_tpu.parallel.h264")
 
@@ -61,7 +63,8 @@ def _merge_idr(enc_p: dev.StripeEncodeOut, enc_i: dev.StripeEncodeOut,
 def make_h264_mesh_step(mesh: Mesh, pad_h: int, pad_w: int, stripe_h: int,
                         *, search: int = dev.SEARCH, cap_frac: int = 4,
                         me: str = "xla", with_idr: bool = False,
-                        prefix: int = 0):
+                        prefix: int = 0, entropy: str = "sparse",
+                        max_stripe_bytes: int = 0):
     """Build the jitted sharded multi-session H.264 step.
 
     Returns (fn, s_local): fn(frames, prev_y, prev_cb, prev_cr, ref_y,
@@ -73,6 +76,11 @@ def make_h264_mesh_step(mesh: Mesh, pad_h: int, pad_w: int, stripe_h: int,
     on ("session", "stripe"). ``me`` defaults to the XLA chunked search:
     the Pallas kernel assumes the TPU backend, and the mesh path must
     also run on the CPU test mesh — TPU deployments pass me="pallas".
+
+    ``entropy="device"`` runs CAVLC shard-local (encoder/device_cavlc.py)
+    so ``buf`` carries per-stripe bit-exact P-slice payloads instead of
+    sparse levels — multi-session steady state then needs ZERO host
+    entropy threads; IDR/overflow stripes still recover from flat16.
     """
     n_stripe_ax = mesh.shape["stripe"]
     if pad_h % (n_stripe_ax * stripe_h):
@@ -109,7 +117,18 @@ def make_h264_mesh_step(mesh: Mesh, pad_h: int, pad_w: int, stripe_h: int,
                 nrcr.reshape(s_local, stripe_h // 2, pad_w // 2)
             ).reshape(h_local // 2, pad_w // 2)
         flat16, _ = dev._pack_levels(enc, damage, update)
-        buf = dev._pack_sparse(flat16, damage, update, cap_frac=cap_frac)
+        if entropy == "device":
+            # shard-local CAVLC: IDR stripes are masked out of the pack
+            # (their merged intra levels are not P-slice material) and
+            # recover from flat16 on the host, like overflow
+            upd_p = update & (idr1 == 0)
+            buf = dcav.pack_p_frame(
+                enc.mv, enc.luma, enc.chroma_dc, enc.chroma_ac,
+                damage, upd_p, mb_w=pad_w // MB, mb_h=stripe_h // MB,
+                max_stripe_bytes=max_stripe_bytes)
+        else:
+            buf = dev._pack_sparse(flat16, damage, update,
+                                   cap_frac=cap_frac)
         # byte-prefix of the content-compacted buffer (head + bitmap +
         # compacted cells), same contract as the solo encoder's
         # two-tier head; harvest refetches exact rows on undershoot
@@ -126,7 +145,7 @@ def make_h264_mesh_step(mesh: Mesh, pad_h: int, pad_w: int, stripe_h: int,
         return (buf[:, None, :], flat16, y, cb, cr, nry, nrcb, nrcr)
 
     plane = P("session", "stripe")
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(plane, plane, plane, plane, plane, plane, plane,
@@ -164,7 +183,8 @@ class MeshH264Encoder:
                  *, stripe_h: int = 64, qp: int = 26, paint_over_qp: int = 18,
                  use_paint_over_quality: bool = True,
                  paint_over_trigger_frames: int = 15,
-                 search: int = dev.SEARCH, me: Optional[str] = None) -> None:
+                 search: int = dev.SEARCH, me: Optional[str] = None,
+                 entropy: Optional[str] = None) -> None:
         n_sess_ax = mesh.shape["session"]
         self.n_stripe_ax = mesh.shape["stripe"]
         if n_sessions % n_sess_ax:
@@ -201,14 +221,32 @@ class MeshH264Encoder:
         self._cap_frac = 8
         self._pad_words, self._n_cells, self._cap_cells = \
             dev.sparse_geometry(self._stripe_words, self._cap_frac)
-        self._fixed_bytes = 4 * self.s_local \
-            + self.s_local * (self._n_cells // 8)
-        self._buf_bytes = self._fixed_bytes \
-            + self._cap_cells * self.s_local * dev.CELL
-        #: per-(session, shard) fetch prefix over the content-compacted
-        #: buffer (same layout as the solo encoder); an undershoot falls
-        #: back to exact flat16 rows and grows the bucket
-        self._prefix = self._bucket(self._fixed_bytes + (32 << 10))
+        #: entropy tier (docs/entropy.md): "device" packs CAVLC shard-
+        #: local so steady state needs no host entropy threads; "host"
+        #: ships sparse levels (the pre-ISSUE-1 path)
+        import os
+        if entropy is None:
+            entropy = os.environ.get("SELKIES_TPU_H264_ENTROPY", "device")
+        if entropy not in ("device", "host"):
+            raise ValueError(f"entropy must be device|host, got {entropy!r}")
+        self.entropy = entropy
+        if entropy == "device":
+            self._cavlc_msb = dcav.default_max_stripe_bytes(
+                self.pad_w // MB, stripe_h // MB)
+            self._fixed_bytes = dcav.HEAD_BYTES * self.s_local
+            self._buf_bytes = self._fixed_bytes \
+                + self.s_local * self._cavlc_msb
+            self._prefix = self._bucket(self._fixed_bytes + (16 << 10))
+        else:
+            self._cavlc_msb = 0
+            self._fixed_bytes = 4 * self.s_local \
+                + self.s_local * (self._n_cells // 8)
+            self._buf_bytes = self._fixed_bytes \
+                + self._cap_cells * self.s_local * dev.CELL
+            #: per-(session, shard) fetch prefix over the content-
+            #: compacted buffer (same layout as the solo encoder); an
+            #: undershoot falls back to flat16 rows and grows the bucket
+            self._prefix = self._bucket(self._fixed_bytes + (32 << 10))
 
         self._steps: Dict[Tuple[bool, int], Any] = {}
 
@@ -271,7 +309,9 @@ class MeshH264Encoder:
             fn, _ = make_h264_mesh_step(
                 self.mesh, self.pad_h, self.pad_w, self.stripe_h,
                 search=self.search, me=self.me, with_idr=with_idr,
-                cap_frac=self._cap_frac, prefix=prefix)
+                cap_frac=self._cap_frac, prefix=prefix,
+                entropy="device" if self.entropy == "device" else "sparse",
+                max_stripe_bytes=self._cavlc_msb)
             self._steps[key] = fn
         return fn
 
@@ -346,29 +386,41 @@ class MeshH264Encoder:
         host = np.asarray(p.prefix)          # [N, stripe_ax, prefix]
         S, sl = self.n_stripes, self.s_local
         CELL = dev.CELL
+        cavlc = self.entropy == "device"
 
-        counts = np.zeros((self.n_sessions, S), np.int64)
         damage = np.zeros((self.n_sessions, S), bool)
         ovf = np.zeros((self.n_sessions, S), bool)
+        counts = np.zeros((self.n_sessions, S), np.int64)
+        t_bits = np.zeros((self.n_sessions, S), np.int64)
+        base_words = np.zeros((self.n_sessions, S), np.int64)
         for k in range(self.n_stripe_ax):
-            head = host[:, k, :4 * sl].reshape(self.n_sessions, sl, 4)
             gs = slice(k * sl, (k + 1) * sl)
-            counts[:, gs] = head[:, :, 0].astype(np.int64) \
-                + (head[:, :, 1].astype(np.int64) << 8)
-            damage[:, gs] = head[:, :, 2] != 0
-            ovf[:, gs] = head[:, :, 3] != 0
+            if cavlc:
+                for n in range(self.n_sessions):
+                    tb, bw, dmg, ov = dcav.parse_cavlc_head(host[n, k], sl)
+                    t_bits[n, gs] = tb
+                    base_words[n, gs] = bw
+                    damage[n, gs] = dmg
+                    ovf[n, gs] = ov
+            else:
+                head = host[:, k, :4 * sl].reshape(self.n_sessions, sl, 4)
+                counts[:, gs] = head[:, :, 0].astype(np.int64) \
+                    + (head[:, :, 1].astype(np.int64) << 8)
+                damage[:, gs] = head[:, :, 2] != 0
+                ovf[:, gs] = head[:, :, 3] != 0
 
         damage[p.reuse_prev] = False
         emit = damage | p.paint | p.idr
         self._static = np.where(damage, 0, self._static + 1)
         self._painted = np.where(damage, False, self._painted)
 
-        # content-compacted cells (same layout as the solo encoder): per
-        # shard, used = min(count, cap)*CELL bytes back to back after the
-        # fixed head+bitmap. An undershoot (compacted content past the
-        # fetched prefix) or per-stripe overflow (count > cap, |level| >
-        # 127 — IDR levels routinely do) recovers from the exact flat16
-        # rows; reads start before any blocks.
+        # per shard: device-CAVLC payload words (bit-exact slice bits) or
+        # content-compacted sparse cells, back to back after the fixed
+        # head. An undershoot (content past the fetched prefix), a
+        # per-stripe overflow, or an IDR stripe (its merged intra levels
+        # are not P-slice material; |level| > 127 routinely in sparse
+        # mode) recovers from the exact flat16 rows; reads start before
+        # any blocks.
         used = np.minimum(counts, self._cap_cells) * CELL
         grew = False
         for n in range(self.n_sessions):
@@ -376,16 +428,27 @@ class MeshH264Encoder:
                 gs = slice(k * sl, (k + 1) * sl)
                 if not emit[n, gs].any():
                     continue
-                needed = self._fixed_bytes + int(used[n, gs].sum())
+                if cavlc:
+                    # clip to the device's per-stripe word capacity: an
+                    # overflow stripe records unclipped t_bits but
+                    # compacts at most V words, and overshooting here
+                    # would pin the grow-only prefix at its cap
+                    wc = np.minimum((t_bits[n, gs] + 31) // 32,
+                                    self._cavlc_msb // 4)
+                    needed = self._fixed_bytes \
+                        + 4 * int(base_words[n, gs][-1] + wc[-1])
+                else:
+                    needed = self._fixed_bytes + int(used[n, gs].sum())
                 if needed > host.shape[-1]:
                     ovf[n, gs] |= emit[n, gs]
                     if not grew:
                         self._prefix = self._bucket(needed + needed // 2)
                         grew = True
+        host_path = ovf | (cavlc & p.idr)
         exact: Dict[Tuple[int, int], Any] = {}
         for n in range(self.n_sessions):
             for g in range(S):
-                if emit[n, g] and ovf[n, g]:
+                if emit[n, g] and host_path[n, g]:
                     row = p.flat16[n, g]
                     row.copy_to_host_async()
                     exact[(n, g)] = row
@@ -398,7 +461,16 @@ class MeshH264Encoder:
                 if not emit[n, g]:
                     continue
                 k, s = g // sl, g % sl
-                if ovf[n, g]:
+                if cavlc and not host_path[n, g]:
+                    # device already entropy-coded the stripe; the job is
+                    # slice-header glue only
+                    pb, nbits = dcav.payload_slice(
+                        host[n, k], sl, base_words[n, k * sl:(k + 1) * sl],
+                        t_bits[n, k * sl:(k + 1) * sl], s)
+                    jobs.append((n, g, False, int(p.qp[n, g]),
+                                 ("bits", pb, nbits)))
+                    continue
+                if host_path[n, g]:
                     row = np.asarray(exact[(n, g)]).astype(np.int32)
                 else:
                     bitmap = host[n, k, 4 * sl:self._fixed_bytes] \
@@ -418,11 +490,16 @@ class MeshH264Encoder:
                 for shape, size in self._shapes:
                     parts.append(row[pos:pos + size].reshape(shape))
                     pos += size
-                jobs.append((n, g, bool(p.idr[n, g]), int(p.qp[n, g]), parts))
+                jobs.append((n, g, bool(p.idr[n, g]), int(p.qp[n, g]),
+                             ("levels", parts)))
 
         def run_one(job):
-            n, g, is_key, qp, parts = job
-            mv, luma, luma_dc, chroma_dc, chroma_ac = parts
+            n, g, is_key, qp, work = job
+            if work[0] == "bits":
+                _, pb, nbits = work
+                return dcav.assemble_p_slice(
+                    pb, nbits, qp, int(self._frame_num[n, g]))
+            mv, luma, luma_dc, chroma_dc, chroma_ac = work[1]
             if is_key:
                 return encode_picture_nals_np(
                     mv, luma, luma_dc, chroma_dc, chroma_ac,

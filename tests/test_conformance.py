@@ -131,6 +131,42 @@ def test_h264_fullframe_mode():
     dec.close()
 
 
+def test_h264_device_cavlc_bit_identical_to_host_path_and_decodes():
+    """ISSUE 1 acceptance: device-packed stripes (entropy='device') must
+    be byte-identical to the host-CAVLC path for P frames AND for the
+    IDR fallback, and must decode bit-exact against the encoder's own
+    reconstruction in libavcodec."""
+    from selkies_tpu.encoder.h264 import H264StripeEncoder
+
+    w, h, sh = 112, 64, 32
+    dev_enc = H264StripeEncoder(w, h, stripe_height=sh, qp=28, search=8,
+                                entropy="device")
+    host_enc = H264StripeEncoder(w, h, stripe_height=sh, qp=28, search=8,
+                                 entropy="host")
+    assert dev_enc.entropy == "device" and host_enc.entropy == "host"
+    decoders = {st.y0: conformance.ConformanceDecoder("h264", max_dim=256)
+                for st in dev_enc.stripes}
+    saw_p = False
+    for t in range(5):
+        frame = _smooth_frame(h, w, seed=13, shift=3 * t)
+        d_stripes = dev_enc.encode_frame(frame)
+        h_stripes = host_enc.encode_frame(frame)
+        assert [s.annexb for s in d_stripes] == \
+            [s.annexb for s in h_stripes], f"t={t}: entropy modes differ"
+        for s in d_stripes:
+            saw_p |= not s.is_key
+            got = decoders[s.y_start].decode(s.annexb)
+            assert got is not None, f"t={t} stripe {s.y_start}"
+            dy, du, dv = got
+            ry, rcb, rcr = dev_enc.stripe_ref(s.y_start // dev_enc.stripe_h)
+            np.testing.assert_array_equal(dy, ry[:s.height, :w])
+            np.testing.assert_array_equal(du, rcb[:s.height // 2, :w // 2])
+            np.testing.assert_array_equal(dv, rcr[:s.height // 2, :w // 2])
+    assert saw_p, "no P frames exercised the device packer"
+    for d in decoders.values():
+        d.close()
+
+
 # ---------------------------------------------------------------------------
 # JPEG
 

@@ -108,13 +108,14 @@ def test_idr_recovery_avoids_batch_program(monkeypatch):
 
     enc = H264StripeEncoder(W, H, stripe_height=32)
     calls = []
-    real = dev.encode_frame_p_batch_rgb
+    for name in ("encode_frame_p_batch_rgb", "encode_frame_p_batch_cavlc_rgb"):
+        real = getattr(dev, name)
 
-    def spy(*a, **k):
-        calls.append(a[0].shape[0])
-        return real(*a, **k)
+        def spy(*a, _real=real, **k):
+            calls.append(a[0].shape[0])
+            return _real(*a, **k)
 
-    monkeypatch.setattr(dev, "encode_frame_p_batch_rgb", spy)
+        monkeypatch.setattr(dev, name, spy)
     frames = frames_seq(4)
     rgbs = jnp.stack([jnp.asarray(f) for f in frames])
     pends = enc.dispatch_batch(rgbs, fetch=True)   # first call: IDR path
@@ -164,6 +165,28 @@ def test_batch_undershoot_recovers_exactly():
             got[seq] = s
     for seq, s in pipe.flush():
         got[seq] = s
+    for i in range(len(frames)):
+        assert annexbs(ref[i]) == annexbs(got[i]), f"frame {i}"
+
+
+def test_flush_drains_partial_batch_buffer():
+    """flush() must dispatch and drain a tail smaller than ``batch``
+    immediately — with the poll deadline pushed out of reach, the only
+    way the buffered frames can exit is flush() itself draining
+    ``_batch_frames``."""
+    frames = frames_seq(5)
+    ref = encode_sequential(frames)
+    enc = H264StripeEncoder(W, H, stripe_height=32)
+    pipe = PipelinedH264Encoder(enc, depth=12, batch=3,
+                                batch_deadline_s=3600.0)
+    got = {}
+    for f in frames:
+        pipe.submit(f)          # one full batch dispatches; 2 stay buffered
+    assert len(pipe._batch_frames) == 2
+    for seq, s in pipe.flush():
+        got[seq] = s
+    assert sorted(got) == list(range(len(frames)))
+    assert not pipe._batch_frames and pipe.n_inflight == 0
     for i in range(len(frames)):
         assert annexbs(ref[i]) == annexbs(got[i]), f"frame {i}"
 

@@ -24,6 +24,17 @@ def test_metrics_render():
     assert "tpuenc_encode_ms_bucket" in text
 
 
+def test_metrics_d2h_and_host_entropy_gauges():
+    """ISSUE 1 satellite: the bottleneck gauges the pipelined encoders
+    record must render."""
+    m = Metrics(port=0)
+    m.set_d2h_bytes_per_frame(12700.0)
+    m.set_host_entropy_ms_per_frame(0.4)
+    text = m.render().decode()
+    assert "tpuenc_d2h_bytes_per_frame 12700.0" in text
+    assert "tpuenc_host_entropy_ms_per_frame 0.4" in text
+
+
 def test_frame_tracer_percentiles():
     tr = FrameTracer(capacity=100)
     for fid in range(10):

@@ -75,6 +75,8 @@ def test_geometry_validation(mesh):
         BatchedSessionEncoder(mesh, 4, W, 48, stripe_h=STRIPE_H)  # 48 % 32
 
 
+@pytest.mark.slow  # ~114 s; the graft-entry ambient-plugin variant keeps
+# the entrypoint covered in tier 1
 def test_dryrun_multichip_entrypoint():
     import sys
     sys.path.insert(0, "/root/repo")
@@ -274,6 +276,9 @@ def test_mesh_h264_idle_keyframe_and_reset(mesh):
     assert np.asarray(menc._ref_y)[0].any()
 
 
+@pytest.mark.slow  # ~43 s; transitively covered in tier 1 —
+# test_mesh_h264_matches_solo pins mesh bytes to the solo encoder's, and
+# test_conformance decodes the solo output in libavcodec
 def test_mesh_h264_decodes_in_conformance_oracle(mesh):
     """Mesh-encoded stripes must decode in libavcodec, IDR then P."""
     from selkies_tpu.encoder import conformance

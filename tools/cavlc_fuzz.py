@@ -1,11 +1,21 @@
-"""CAVLC conformance fuzzer: crafted level arrays → C++ coder → ffmpeg.
+"""CAVLC conformance fuzzer: crafted level arrays → C++ coder → ffmpeg,
+and the device-CAVLC differential mode.
 
-Drives h264_encode_picture with synthetic quantized-level arrays (bypassing
-the device transforms) so every (totalCoeff, trailingOnes, nC-class,
-total_zeros, run_before) table entry gets exercised, then decodes with
-OpenCV/ffmpeg and compares against the NumpyMirror reconstruction.  Used to
-validate the hand-entered spec tables in native/cavlc.cpp; kept as a tool
-(tests run a bounded version).
+Mode 1 (``python tools/cavlc_fuzz.py [n]``): drives h264_encode_picture
+with synthetic quantized-level arrays (bypassing the device transforms) so
+every (totalCoeff, trailingOnes, nC-class, total_zeros, run_before) table
+entry gets exercised, then decodes with OpenCV/ffmpeg and compares against
+the NumpyMirror reconstruction.  Validates the hand-entered spec tables in
+native/cavlc.cpp.
+
+Mode 2 (``python tools/cavlc_fuzz.py --device [n]``): differential-fuzzes
+the ON-DEVICE CAVLC packer (encoder/device_cavlc.py) against the native
+_libselkies_cavlc.so reference over random P-frame level tensors — full
+residual surface (luma + chroma DC/AC), random MVs (skip/mvd paths),
+|level| > 127 edges and escape-overflow magnitudes.  Non-overflow stripes
+must be BIT-IDENTICAL; overflow stripes must be flagged (they take the
+flat16 + host fallback in the product).  tests/test_device_cavlc.py runs a
+seeded subset of this under tier 1.
 """
 
 import os
@@ -15,8 +25,6 @@ import tempfile
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-
-import cv2  # noqa: E402
 
 from selkies_tpu.encoder.h264 import make_pps, make_sps  # noqa: E402
 from selkies_tpu.native import cavlc_lib  # noqa: E402
@@ -60,6 +68,8 @@ def encode_two_frames(luma_levels, mb_w, mb_h, qp):
 
 
 def decode_stream(data):
+    import cv2  # lazy: the --device mode needs no decoder
+
     path = tempfile.mktemp(suffix=".h264")
     with open(path, "wb") as f:
         f.write(data)
@@ -100,8 +110,95 @@ def check_seed(seed, qp=26, mb_w=2, mb_h=2, density=None, magnitude=None):
     return True, "", levels
 
 
+def random_p_frame(rng, S, n_mb, density, magnitude, mv_range=12):
+    """Random device-encoder-shaped P-frame level tensors for S stripes."""
+    def sparse(shape, mag):
+        lv = rng.integers(-mag, mag + 1, shape)
+        return (lv * (rng.random(shape) < density)).astype(np.int32)
+
+    mv = rng.integers(-mv_range, mv_range + 1, (S, n_mb, 2)).astype(np.int32)
+    if rng.random() < 0.3:
+        mv[:] = 0                        # all-skip / skip-run paths
+    elif rng.random() < 0.3:
+        mv[:] = mv[:, :1]                # uniform motion → long skip runs
+    luma = sparse((S, n_mb, 16, 4, 4), magnitude)
+    cdc = sparse((S, n_mb, 2, 2, 2), magnitude)
+    cac = sparse((S, n_mb, 2, 4, 4, 4), magnitude)
+    cac[..., 0, 0] = 0                   # device zeroes the AC DC slot
+    return mv, luma, cdc, cac
+
+
+def check_device_seed(seed, mb_w=None, mb_h=None, S=2, qp=None,
+                      frame_num=None, max_stripe_bytes=65536):
+    """Differential: device pack + host glue vs native coder, one seed.
+
+    Returns (ok, why, n_overflow).  Overflow stripes are exempt from the
+    bit-compare (the product recodes them from flat16 via the native
+    path, which IS the reference — trivially identical) but must be
+    flagged so that fallback actually engages.
+    """
+    import jax.numpy as jnp
+
+    from selkies_tpu.encoder import device_cavlc as dcav
+    from selkies_tpu.encoder.h264 import encode_picture_nals_np
+
+    rng = np.random.default_rng(seed)
+    mb_w = mb_w if mb_w is not None else int(rng.integers(2, 7))
+    mb_h = mb_h if mb_h is not None else int(rng.integers(1, 4))
+    qp = qp if qp is not None else int(rng.integers(10, 48))
+    frame_num = frame_num if frame_num is not None else int(
+        rng.integers(1, 16))
+    density = rng.uniform(0.02, 0.9)
+    # |level| > 127 (int8-sparse overflow) and escape-overflow (> ~2064)
+    # edges both land regularly
+    magnitude = int(rng.choice([1, 2, 8, 30, 127, 200, 2063, 2500]))
+    n_mb = mb_w * mb_h
+    mv, luma, cdc, cac = random_p_frame(rng, S, n_mb, density, magnitude)
+
+    words, t_bits, base_words, ovf = [np.asarray(x) for x in (
+        dcav.pack_p_frame_words(
+            jnp.asarray(mv), jnp.asarray(luma), jnp.asarray(cdc),
+            jnp.asarray(cac), jnp.ones(S, bool),
+            mb_w=mb_w, mb_h=mb_h, max_stripe_bytes=max_stripe_bytes))]
+    payload = np.stack(
+        [(words >> 24) & 0xFF, (words >> 16) & 0xFF,
+         (words >> 8) & 0xFF, words & 0xFF], -1).astype(np.uint8).reshape(-1)
+
+    ldc = np.zeros((n_mb, 4, 4), np.int32)
+    for s in range(S):
+        ref = encode_picture_nals_np(
+            mv[s], luma[s], ldc, cdc[s], cac[s], is_idr=False,
+            mb_w=mb_w, mb_h=mb_h, qp=qp, frame_num=frame_num)
+        if ovf[s]:
+            continue
+        start = int(base_words[s]) * 4
+        nbits = int(t_bits[s])
+        got = dcav.assemble_p_slice(
+            payload[start:start + ((nbits + 31) // 32) * 4],
+            nbits, qp, frame_num)
+        if got != ref:
+            return False, f"stripe {s} bit mismatch", int(ovf.sum())
+    return True, "", int(ovf.sum())
+
+
+def main_device(n):
+    fails, n_ovf = [], 0
+    for seed in range(n):
+        ok, why, ovf = check_device_seed(seed)
+        n_ovf += ovf
+        if not ok:
+            fails.append((seed, why))
+            print(f"seed {seed}: FAIL ({why})")
+    print(f"{n - len(fails)}/{n} passed ({n_ovf} overflow stripes "
+          "took the flagged fallback)")
+    return 1 if fails else 0
+
+
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500
+    args = [a for a in sys.argv[1:] if a != "--device"]
+    n = int(args[0]) if args else 500
+    if "--device" in sys.argv:
+        return main_device(n)
     fails = []
     for seed in range(n):
         ok, why, _ = check_seed(seed)

@@ -25,6 +25,12 @@ Outputs one JSON line:
   cavlc_ms_frame                    — host entropy coding per frame
   cavlc_scaling                     — CAVLC wall time at 1/2/4/8 pool threads
 
+The sweep/attribution runs the HOST-entropy profile (entropy="host"):
+this tool decomposes the sparse-levels + host-CAVLC path, and its stage
+stubs target dev._pack_sparse / the native coder. The streaming default
+is the on-device CAVLC tier (encoder/device_cavlc.py, docs/entropy.md);
+its device cost shows up in the separate cavlc_pack_ms slope below.
+
 Run: ``python tools/h264_stages.py [--frames N] [--attribute]``.
 """
 
@@ -91,7 +97,7 @@ def measure(width: int = W, height: int = H, b1: int = 6, b2: int = 12,
     from selkies_tpu.encoder import h264_device as dev
     from selkies_tpu.encoder.h264 import H264StripeEncoder
 
-    enc = H264StripeEncoder(width, height)
+    enc = H264StripeEncoder(width, height, entropy="host")
     src = DeviceScrollSource(width, enc.pad_h)
     enc.encode_frame(src.next_frame())          # IDR + compiles
     enc.encode_frame(src.next_frame())
@@ -130,7 +136,7 @@ def measure(width: int = W, height: int = H, b1: int = 6, b2: int = 12,
         try:
             jax.clear_caches()
             dev.me_mc_stripes = me_stub
-            e2 = H264StripeEncoder(width, height)
+            e2 = H264StripeEncoder(width, height, entropy="host")
             s2 = DeviceScrollSource(width, e2.pad_h)
             e2.encode_frame(s2.next_frame())
             e2.encode_frame(s2.next_frame())
@@ -140,7 +146,7 @@ def measure(width: int = W, height: int = H, b1: int = 6, b2: int = 12,
         try:
             jax.clear_caches()
             dev._pack_sparse = pack_stub
-            e3 = H264StripeEncoder(width, height)
+            e3 = H264StripeEncoder(width, height, entropy="host")
             s3 = DeviceScrollSource(width, e3.pad_h)
             e3.encode_frame(s3.next_frame())
             e3.encode_frame(s3.next_frame())
@@ -163,6 +169,24 @@ def measure(width: int = W, height: int = H, b1: int = 6, b2: int = 12,
                              + 2 * nby * enc.pad_w * nbx)
         out["me_tflops"] = round(flops / (me_ms / 1000.0) / 1e12, 2) \
             if me_ms > 0 else None
+
+    # device-CAVLC tier: in-context slope of the streaming default's
+    # batched program minus the host-tier program (both one-dispatch
+    # scans; the difference is the device entropy pack net of the
+    # sparse pack it replaces)
+    try:
+        jax.clear_caches()
+        e4 = H264StripeEncoder(width, height)           # entropy="device"
+        s4 = DeviceScrollSource(width, e4.pad_h)
+        e4.encode_frame(s4.next_frame())
+        e4.encode_frame(s4.next_frame())
+        dev_slope, _, _, _ = _sweep(e4, s4, b1, b2, chain, reps)
+        out["device_entropy_ms_per_frame"] = round(dev_slope, 2)
+        out["cavlc_pack_ms"] = round(dev_slope - slope, 2)
+    except Exception as e:
+        out["device_entropy_error"] = repr(e)
+    finally:
+        jax.clear_caches()
 
     # host CAVLC: one frame fetched, then entropy-only timing; also its
     # scaling over pool sizes (headroom for 4K / multi-session)
