@@ -205,6 +205,8 @@ def bench_h264() -> dict:
         "h264_d2h_bytes_per_frame": round(st["d2h_bytes_per_frame"]),
         "h264_host_entropy_ms_per_frame":
             round(st["host_entropy_ms_per_frame"], 2),
+        "h264_frames_dropped": st.get("frames_dropped", 0),
+        "h264_entropy_errors": st.get("entropy_errors", 0),
         "h264_device_ms_per_frame": round(dev_ms, 2),
         "h264_device_fps": round(1000.0 / dev_ms, 1) if dev_ms > 0 else None,
         "h264_device_note": (
@@ -454,6 +456,12 @@ def main() -> None:
             round(jpeg_stats.get("d2h_bytes_per_frame", 0)),
         "jpeg_host_entropy_ms_per_frame":
             round(jpeg_stats.get("host_entropy_ms_per_frame", 0), 2),
+        # robustness accounting (ISSUE 2 satellite): dropped/errored
+        # frames and host entropy fallbacks are results, not log noise —
+        # a throughput headline that silently dropped frames is a lie
+        "jpeg_frames_dropped": jpeg_stats.get("frames_dropped", 0),
+        "jpeg_host_fallback_stripes":
+            jpeg_stats.get("host_fallback_stripes", 0),
     }
     try:
         result.update(bench_glass_to_glass())

@@ -374,6 +374,10 @@ class H264StripeEncoder:
         #: pipeline / bench can report per-frame gauges
         self.host_entropy_ms_total = 0.0
         self.d2h_refetch_bytes_total = 0
+        #: stripes whose entropy coding failed and forced an IDR resync —
+        #: repeated growth here is the signal the degradation ladder acts
+        #: on (ISSUE 2: rung device -> host -> jpeg)
+        self.entropy_errors_total = 0
 
     def _choose_prefix(self) -> int:
         """Pick between the two compiled head sizes from the adaptive
@@ -746,6 +750,7 @@ class H264StripeEncoder:
                 # the device ref already advanced to a reconstruction the
                 # decoder will never see — resynchronize with an IDR
                 # instead of drifting every following P frame
+                self.entropy_errors_total += 1
                 logger.error("entropy coding failed for stripe %d; "
                              "forcing IDR resync", i, exc_info=payload)
                 st.need_idr = True

@@ -225,6 +225,11 @@ class JpegStripeEncoder:
 
         self.set_quality(quality, paintover_quality)
 
+        #: overflowed stripes that fell back to host entropy coding —
+        #: sustained growth means the device packing budget is wrong for
+        #: this content and the degradation ladder's host rung is cheaper
+        self.host_fallback_stripes_total = 0
+
         self._prev = jnp.zeros((self.pad_h, self.pad_w, 3), dtype=jnp.uint8)
         self._static_frames = np.zeros(self.n_stripes, dtype=np.int64)
         self._painted = np.zeros(self.n_stripes, dtype=bool)
@@ -383,6 +388,7 @@ class JpegStripeEncoder:
             if not emit[s]:
                 continue
             if ovf_np[s]:  # pathological stripe: host-code its coeffs
+                self.host_fallback_stripes_total += 1
                 scans[s] = _entropy_encode_420(
                     np.asarray(yq[s * yrows:(s + 1) * yrows]),
                     np.asarray(cbq[s * crows:(s + 1) * crows]),

@@ -98,6 +98,9 @@ class PipelinedJpegEncoder:
         self.d2h_bytes_total = 0
         self.host_entropy_ms_total = 0.0
         self.frames_completed = 0
+        #: frames rejected by try_submit because the pipeline was full —
+        #: surfaced in stats()/metrics instead of vanishing (ISSUE 2)
+        self.frames_dropped_total = 0
 
     def stats(self) -> dict:
         """Per-frame transfer/host-entropy gauges over the run so far."""
@@ -106,6 +109,10 @@ class PipelinedJpegEncoder:
             "frames": self.frames_completed,
             "d2h_bytes_per_frame": self.d2h_bytes_total / n,
             "host_entropy_ms_per_frame": self.host_entropy_ms_total / n,
+            "frames_dropped": self.frames_dropped_total,
+            "host_fallback_stripes": getattr(
+                self.base, "host_fallback_stripes_total", 0),
+            "entropy": self.base.entropy,
         }
 
     def _publish_metrics(self) -> None:
@@ -131,6 +138,9 @@ class PipelinedJpegEncoder:
         so a saturated pipeline degrades by dropping frames instead."""
         self._advance_ready()
         if len(self._inflight) >= self.depth:
+            self.frames_dropped_total += 1
+            if self.metrics is not None:
+                self.metrics.inc_frames_dropped()
             return None
         return self._dispatch(frame)
 
@@ -307,34 +317,79 @@ class ThreadedEncoderAdapter:
     under overload exactly like try_submit does."""
 
     def __init__(self, base, depth: int = 3,
-                 wire_fullframe: bool = False) -> None:
+                 wire_fullframe: bool = False, metrics=None) -> None:
         import concurrent.futures
 
         self.base = base
         self.depth = depth
         #: ship as one 0x00 full-frame packet instead of 0x04 stripes
         self.wire_fullframe = wire_fullframe
+        #: observability Metrics (inc_frames_dropped / inc_encode_errors);
+        #: the server attaches its instance after construction
+        self.metrics = metrics
+        #: called with the exception for every errored frame — the server
+        #: routes this into the degradation ladder (ISSUE 2)
+        self.on_error = None
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tpuenc")
         self._pending: deque = deque()
         self._done: List = []
         self._seq = 0
+        self.frames_completed = 0
+        self.frames_dropped_total = 0
+        self.encode_errors_total = 0
+
+    def stats(self) -> dict:
+        """Drop/error accounting plus the base encoder's entropy gauges
+        (same shape as the pipelined encoders' stats for bench/health)."""
+        n = max(1, self.frames_completed)
+        return {
+            "frames": self.frames_completed,
+            "frames_dropped": self.frames_dropped_total,
+            "encode_errors": self.encode_errors_total,
+            "d2h_bytes_per_frame":
+                getattr(self.base, "d2h_refetch_bytes_total", 0) / n,
+            "host_entropy_ms_per_frame":
+                getattr(self.base, "host_entropy_ms_total", 0.0) / n,
+            "entropy": getattr(self.base, "entropy", None),
+        }
 
     def try_submit(self, frame) -> Optional[int]:
         self._harvest()
         if len(self._pending) >= self.depth:
+            self.frames_dropped_total += 1
+            if self.metrics is not None:
+                self.metrics.inc_frames_dropped()
             return None
         return self.submit(frame)
+
+    def _settle(self, seq: int, fut, out: List) -> None:
+        """Resolve one finished encode future into ``out`` with full
+        error accounting (shared by the poll and flush drains)."""
+        try:
+            out.append((seq, fut.result()))
+            self.frames_completed += 1
+        except Exception as exc:
+            # encoder error: the frame is lost, but it must be COUNTED
+            # (metrics + stats) and REPORTED (ladder hook), not just
+            # logged — silent decay is what ISSUE 2 removes
+            import logging
+
+            self.encode_errors_total += 1
+            if self.metrics is not None:
+                self.metrics.inc_encode_errors()
+            logging.getLogger(__name__).exception("encode failed")
+            if self.on_error is not None:
+                try:
+                    self.on_error(exc)
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "encode on_error hook failed")
 
     def _harvest(self) -> None:
         while self._pending and self._pending[0][1].done():
             seq, fut = self._pending.popleft()
-            try:
-                self._done.append((seq, fut.result()))
-            except Exception:  # encoder error: drop the frame, keep going
-                import logging
-
-                logging.getLogger(__name__).exception("encode failed")
+            self._settle(seq, fut, self._done)
 
     def submit(self, frame) -> int:
         # defensive crop: encoder dims can be tighter than the source's
@@ -376,14 +431,16 @@ class ThreadedEncoderAdapter:
         out, self._done = self._done, []
         while self._pending:
             seq, fut = self._pending.popleft()
-            try:
-                out.append((seq, fut.result()))
-            except Exception:
-                pass
+            self._settle(seq, fut, out)
         return out
 
     def close(self) -> None:
-        """Stop the worker and abandon queued frames (display teardown)."""
+        """Stop the worker and abandon queued frames (display teardown).
+
+        An encode_frame ALREADY RUNNING cannot be interrupted — a truly
+        hung native coder leaves its thread blocked past shutdown. The
+        server bounds that exposure (DisplayState.wedge_faults caps
+        rebuild cycles of a wedged bottom-rung encoder)."""
         self._pool.shutdown(wait=False, cancel_futures=True)
         self._pending.clear()
         self._done.clear()
@@ -422,6 +479,7 @@ class PipelinedH264Encoder:
         self.metrics = metrics
         self.d2h_bytes_total = 0
         self.frames_completed = 0
+        self.frames_dropped_total = 0
         #: frames encoded per device dispatch (dev.encode_frame_p_batch_rgb)
         #: — RPC-attached transports pay per dispatch, so batch>1 divides
         #: that cost; PCIe deployments keep 1 (no added latency)
@@ -459,6 +517,9 @@ class PipelinedH264Encoder:
             "frames": self.frames_completed,
             "d2h_bytes_per_frame": d2h / n,
             "host_entropy_ms_per_frame": ems / n,
+            "frames_dropped": self.frames_dropped_total,
+            "entropy_errors": getattr(self.base, "entropy_errors_total", 0),
+            "entropy": getattr(self.base, "entropy", None),
         }
 
     def _publish_metrics(self) -> None:
@@ -483,6 +544,9 @@ class PipelinedH264Encoder:
 
     def try_submit(self, frame) -> Optional[int]:
         if len(self._inflight) >= self.depth:
+            self.frames_dropped_total += 1
+            if self.metrics is not None:
+                self.metrics.inc_frames_dropped()
             return None
         return self.submit(frame)
 

@@ -17,8 +17,8 @@ logger = logging.getLogger("selkies_tpu.observability.metrics")
 
 try:
     import prometheus_client as prom
-    from prometheus_client import (CollectorRegistry, Gauge, Histogram, Info,
-                                   start_http_server)
+    from prometheus_client import (CollectorRegistry, Counter, Gauge,
+                                   Histogram, Info, start_http_server)
     HAVE_PROM = True
 except Exception:  # pragma: no cover
     HAVE_PROM = False
@@ -65,6 +65,31 @@ class Metrics:
             "wall time per frame (native CAVLC / overflow fallbacks; ~0 "
             "when the device entropy tiers carry steady state)",
             registry=self.registry)
+        # ISSUE 2: supervision / degradation observability — dropped and
+        # errored frames were previously log lines only; restart and ladder
+        # activity must be scrapeable to be actionable
+        self.frames_dropped = Counter(
+            "frames_dropped_total", "Frames dropped by saturated or "
+            "errored encode pipelines", registry=self.registry)
+        self.encode_errors = Counter(
+            "encode_errors_total", "Frames lost to encoder exceptions",
+            registry=self.registry)
+        self.watchdog_restarts = Counter(
+            "watchdog_restarts_total", "Pipeline restarts triggered by the "
+            "frame-deadline watchdog (stalled capture/fetch)",
+            registry=self.registry)
+        self.supervisor_restarts = Counter(
+            "supervisor_restarts_total", "Supervised restarts of display "
+            "capture/backpressure loops (crash + watchdog + clean)",
+            registry=self.registry)
+        self.degradation_rung = Gauge(
+            "degradation_rung", "Worst degradation-ladder rung across "
+            "displays (0 device entropy, 1 host entropy, 2 jpeg fallback)",
+            registry=self.registry)
+        self.failed_displays = Gauge(
+            "failed_displays", "Displays whose supervisor exhausted its "
+            "restart budget (terminal failed state)",
+            registry=self.registry)
         self.clients = Gauge("connected_clients", "WebSocket clients",
                              registry=self.registry)
         self.backpressured = Gauge(
@@ -107,6 +132,30 @@ class Metrics:
     def set_host_entropy_ms_per_frame(self, ms: float) -> None:
         if HAVE_PROM:
             self.host_entropy_ms_per_frame.set(ms)
+
+    def inc_frames_dropped(self, n: int = 1) -> None:
+        if HAVE_PROM and n > 0:
+            self.frames_dropped.inc(n)
+
+    def inc_encode_errors(self, n: int = 1) -> None:
+        if HAVE_PROM and n > 0:
+            self.encode_errors.inc(n)
+
+    def inc_watchdog_restart(self) -> None:
+        if HAVE_PROM:
+            self.watchdog_restarts.inc()
+
+    def inc_supervisor_restart(self) -> None:
+        if HAVE_PROM:
+            self.supervisor_restarts.inc()
+
+    def set_degradation_rung(self, level: int) -> None:
+        if HAVE_PROM:
+            self.degradation_rung.set(level)
+
+    def set_failed_displays(self, n: int) -> None:
+        if HAVE_PROM:
+            self.failed_displays.set(n)
 
     def set_clients(self, n: int) -> None:
         if HAVE_PROM:

@@ -130,6 +130,16 @@ class MeshEncodeCoordinator:
         self._thread: Optional[threading.Thread] = None
         #: total coded bytes per slot from the device rate feedback
         self.coded_bytes = [0] * n_sessions
+        #: per-shard fault accounting (ISSUE 2): frames lost to failed
+        #: dispatch/harvest ticks, counted against the slots that were in
+        #: that tick so a single noisy session is attributable
+        self.slot_errors = [0] * n_sessions
+        #: failed ticks total plus the worker's consecutive-failure streak
+        #: (drives the capped backoff in _run)
+        self.tick_errors_total = 0
+        self._consecutive_tick_failures = 0
+        #: times the worker thread was found dead and re-spawned
+        self.worker_restarts_total = 0
         #: bumped on every acquire: harvests tagged with an older generation
         #: are dropped so a reused slot never receives the previous
         #: occupant's pixels (results dispatched before the handover)
@@ -215,6 +225,10 @@ class MeshEncodeCoordinator:
 
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
+            if self._thread is not None:
+                # the previous worker died (tick exception storm or device
+                # loss); account for the re-spawn so it is observable
+                self.worker_restarts_total += 1
             self._stop.clear()
             self._thread = threading.Thread(
                 target=self._run, name="mesh-encode", daemon=True)
@@ -234,9 +248,30 @@ class MeshEncodeCoordinator:
             next_tick = max(next_tick + interval, now - interval)
             try:
                 self._tick()
+                self._consecutive_tick_failures = 0
             except Exception:
-                logger.exception("mesh encode tick failed")
-                time.sleep(0.5)
+                # _tick already reattributed the failed slots; back off with
+                # a capped exponential so a persistent device fault doesn't
+                # spin the worker at tick rate
+                self.tick_errors_total += 1
+                self._consecutive_tick_failures += 1
+                logger.exception("mesh encode tick failed (streak %d)",
+                                 self._consecutive_tick_failures)
+                # interruptible: stop() must not wait out the backoff
+                from ..robustness import backoff_delay
+
+                self._stop.wait(backoff_delay(
+                    self._consecutive_tick_failures, 0.5, 5.0))
+
+    def stats(self) -> dict:
+        """Per-shard fault/restart accounting for health feeds and tests."""
+        with self._lock:
+            return {
+                "active_sessions": len(self._attached),
+                "tick_errors_total": self.tick_errors_total,
+                "worker_restarts_total": self.worker_restarts_total,
+                "slot_errors": list(self.slot_errors),
+            }
 
     def _tick(self) -> None:
         """Dispatch this tick's frames, then harvest the *previous* tick's
@@ -259,10 +294,27 @@ class MeshEncodeCoordinator:
                     frames[slot] = self._pending.pop(slot)
                     took.append((slot, self._gen[slot]))
             self._inflight_slots |= {s for s, _ in took}
-        pending = self.enc.dispatch(frames) if took else None
+        try:
+            pending = self.enc.dispatch(frames) if took else None
+        except Exception:
+            # a failed dispatch must not strand its slots in
+            # _inflight_slots (facade.flush would block on them forever);
+            # attribute the lost frames per shard, then let _run back off
+            with self._lock:
+                for slot, _ in took:
+                    self.slot_errors[slot] += 1
+                self._inflight_slots = {s for s, _ in self._inflight[1]}
+            raise
         prev, self._inflight = self._inflight, (pending, took)
         if prev is not None and prev[0] is not None:
-            out, session_bytes = self.enc.harvest(prev[0])
+            try:
+                out, session_bytes = self.enc.harvest(prev[0])
+            except Exception:
+                with self._lock:
+                    for slot, _ in prev[1]:
+                        self.slot_errors[slot] += 1
+                    self._inflight_slots = {s for s, _ in self._inflight[1]}
+                raise
             with self._lock:
                 # a slot can be in BOTH the harvested and the new dispatch;
                 # recompute membership rather than discarding per slot

@@ -26,9 +26,10 @@ Frame IDs are unsigned 16-bit with wraparound; see :class:`FrameId`.
 from __future__ import annotations
 
 import enum
+import json
 import struct
 from dataclasses import dataclass
-from typing import Optional, Tuple, Union
+from typing import Dict, Optional, Tuple, Union
 
 
 class BinaryType(enum.IntEnum):
@@ -156,6 +157,22 @@ def pack_full_frame(frame_id: int, annexb: bytes, is_key: bool) -> bytes:
     )
 
 
+def pack_system_health(displays: Dict[str, Dict]) -> str:
+    """The ``system,health`` feed: per-display supervision state pushed to
+    clients so degraded sessions are visible, not silent.
+
+    ``displays`` maps display_id to a dict with at least ``rung`` (current
+    degradation-ladder rung, see :data:`~selkies_tpu.robustness.RUNGS`),
+    ``supervisor`` (lifecycle state), and the restart counters. Rides the
+    same JSON channel as the stats feed; clients switch on ``type``.
+    """
+    return json.dumps({
+        "type": "system_health",
+        "subsystem": "system,health",
+        "displays": displays,
+    })
+
+
 def pack_audio_chunk(opus: bytes) -> bytes:
     """[0x01][0x00][opus] (selkies-core.js:2874-2880, server selkies.py:976)."""
     return bytes((BinaryType.AUDIO_OPUS, 0)) + opus
@@ -258,7 +275,9 @@ def unpack_binary(
 #
 #   MODE websockets
 #   {json} with "type": server_settings | system_stats | gpu_stats |
-#          network_stats | stream_resolution | display_config_update
+#          network_stats | stream_resolution | display_config_update |
+#          system_health (supervision/degradation state, "system,health"
+#          feed — pack_system_health below)
 #   cursor,{json}
 #   clipboard,<b64> | clipboard_binary,<mime>,<b64>
 #   clipboard_start,<mime>,<size> clipboard_data,<b64> clipboard_finish

@@ -28,7 +28,16 @@ from ..protocol.wire import (
     pack_full_frame,
     pack_h264_stripe,
     pack_jpeg_stripe,
+    pack_system_health,
     parse_text_message,
+)
+from ..robustness import (
+    FAILED,
+    DegradationLadder,
+    EncoderFault,
+    FaultInjector,
+    Supervisor,
+    backoff_delay,
 )
 from ..settings import SETTING_DEFINITIONS, Settings
 from .backpressure import CHECK_INTERVAL_S, BackpressureState
@@ -37,6 +46,31 @@ logger = logging.getLogger("selkies_tpu.server")
 
 STATS_INTERVAL_S = 5.0
 UPLOAD_DIR_ENV = "SELKIES_UPLOAD_DIR"
+
+
+def _ws_broadcast(targets, message) -> None:
+    """Fan one message out to many clients.
+
+    Real websockets go through ``websockets.broadcast`` (non-blocking,
+    drops slow consumers at the transport layer). Targets exposing a
+    synchronous ``send_nowait`` are served directly instead — that keeps
+    the whole data plane drivable by in-process fakes on hosts without the
+    websockets package (fault-injection tests, tools/chaos_run.py) and
+    open to alternative transports."""
+    real = []
+    for t in targets:
+        fn = getattr(t, "send_nowait", None)
+        if fn is not None:
+            try:
+                fn(message)
+            except Exception:
+                logger.debug("send_nowait target failed", exc_info=True)
+        else:
+            real.append(t)
+    if real:
+        import websockets
+
+        websockets.broadcast(real, message)
 
 
 def upload_dir() -> str:
@@ -56,12 +90,21 @@ def default_encoder_factory(
     settings.py 'encoder' / pixelflux output_mode): ``jpeg`` is the
     device-entropy striped pipeline; ``x264enc-striped``/``x264enc`` are
     the TPU H.264 profiles (striped / one full-frame stripe). CRF settings
-    map onto the QP scale (both 0-51)."""
+    map onto the QP scale (both 0-51).
+
+    The degradation ladder (docs/robustness.md) rides the ``tpu_entropy``
+    override: ``host`` builds the encoder with host-side entropy coding;
+    the ladder's last rung additionally forces ``encoder=jpeg``. Entropy is
+    fixed at construction (the device programs are compiled per tier), so a
+    rung change takes effect as a supervised pipeline restart."""
     from ..encoder.jpeg import JpegStripeEncoder
     from ..encoder.pipeline import PipelinedJpegEncoder, ThreadedEncoderAdapter
 
     ov = overrides or {}
     profile = ov.get("encoder", settings.encoder)
+    #: None → the encoder's own default (H.264 honors the
+    #: SELKIES_TPU_H264_ENTROPY env tier selection; JPEG is device)
+    entropy = ov.get("tpu_entropy")
     if profile in ("x264enc", "x264enc-striped"):
         from ..encoder.h264 import H264StripeEncoder
 
@@ -78,24 +121,29 @@ def default_encoder_factory(
             stripe_height=int(settings.tpu_stripe_height),
             qp=crf, paint_over_qp=paint_crf,
             fullframe=(profile == "x264enc"),
+            entropy=entropy,
         ), depth=3, wire_fullframe=(profile == "x264enc"))
-    return PipelinedJpegEncoder(
-        JpegStripeEncoder(
-            width,
-            height,
-            stripe_height=settings.tpu_stripe_height,
-            quality=ov.get("jpeg_quality", settings.jpeg_quality.default),
-            paintover_quality=ov.get(
-                "paint_over_jpeg_quality",
-                settings.paint_over_jpeg_quality.default),
-            use_paint_over_quality=ov.get(
-                "use_paint_over_quality",
-                settings.use_paint_over_quality.value),
-            watermark_path=str(settings.watermark_path),
-            watermark_location=int(settings.watermark_location),
-        ),
-        depth=3,
+    base = JpegStripeEncoder(
+        width,
+        height,
+        stripe_height=settings.tpu_stripe_height,
+        quality=ov.get("jpeg_quality", settings.jpeg_quality.default),
+        paintover_quality=ov.get(
+            "paint_over_jpeg_quality",
+            settings.paint_over_jpeg_quality.default),
+        use_paint_over_quality=ov.get(
+            "use_paint_over_quality",
+            settings.use_paint_over_quality.value),
+        entropy=entropy or "device",
+        watermark_path=str(settings.watermark_path),
+        watermark_location=int(settings.watermark_location),
     )
+    if base.entropy != "device":
+        # degraded rung: host entropy coding can't ride the device-packed
+        # pipeline, so the synchronous encode_frame path runs off-loop in
+        # the threaded adapter instead
+        return ThreadedEncoderAdapter(base, depth=3)
+    return PipelinedJpegEncoder(base, depth=3)
 
 
 def default_source_factory(width: int, height: int, fps: float,
@@ -124,6 +172,22 @@ class DisplayState:
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
     capture_task: Optional[asyncio.Task] = None
     backpressure_task: Optional[asyncio.Task] = None
+    #: supervisors owning the two loops above (ISSUE 2): crash restarts
+    #: with bounded backoff, frame-deadline watchdog, restart budget
+    supervisor: Optional[Supervisor] = None
+    bp_supervisor: Optional[Supervisor] = None
+    #: encoder degradation state (device -> host -> jpeg); persists across
+    #: supervised restarts and reconfigures — it is display health, not
+    #: pipeline state
+    ladder: DegradationLadder = field(default_factory=DegradationLadder)
+    #: sticky terminal marker: the capture supervisor exhausted its restart
+    #: budget and the pipeline was torn down (cleared by an explicit
+    #: START_VIDEO / reconfigure restart)
+    failed: bool = False
+    #: wedge faults at the bottom rung (nowhere left to degrade): each
+    #: restart of a hung encoder can abandon a blocked worker thread, so
+    #: these are bounded — a few strikes and the display goes terminal
+    wedge_faults: int = 0
     video_active: bool = True
     #: clamped per-client setting overrides from the SETTINGS handshake
     overrides: Dict[str, Any] = field(default_factory=dict)
@@ -181,6 +245,15 @@ class DataStreamingServer:
         #: counters surfaced in the stats JSON so mesh fallbacks are
         #: observable, not silent
         self.mesh_stats = {"bucketed": 0, "solo_fallback": 0}
+        #: fault-injection registry for this server (docs/robustness.md):
+        #: armed from the tpu_faults setting / SELKIES_TPU_FAULTS env and
+        #: checked at the real capture/encode/fetch/ws call sites
+        self.faults = FaultInjector(str(getattr(settings, "tpu_faults", "")
+                                        or ""))
+        #: fire-and-forget helpers (ws.drop closes, failed-display
+        #: teardown) — referenced so they are neither GC'd mid-flight nor
+        #: left to warn "exception was never retrieved"
+        self._bg_tasks: Set[asyncio.Task] = set()
 
     @property
     def mesh_coordinator(self):
@@ -191,10 +264,8 @@ class DataStreamingServer:
     # broadcast primitives
 
     def broadcast(self, message) -> None:
-        import websockets
-
         if self.clients:
-            websockets.broadcast(self.clients, message)
+            _ws_broadcast(self.clients, message)
             if isinstance(message, (bytes, bytearray)):
                 self.bytes_sent += len(message) * len(self.clients)
 
@@ -209,12 +280,20 @@ class DataStreamingServer:
     # ------------------------------------------------------------------
     # lifecycle
 
+    #: bind-retry policy: capped exponential backoff with jitter, then a
+    #: hard error — an occupied port must fail loudly, not retry at a
+    #: fixed 1 Hz forever (class attributes so tests can shrink them)
+    BIND_MAX_ATTEMPTS = 8
+    BIND_BASE_DELAY_S = 0.5
+    BIND_MAX_DELAY_S = 10.0
+
     async def run_server(self) -> None:
         """Serve until stop() — with crash-restart supervision like the
         reference's run loop (selkies.py:2453-2510)."""
         import websockets.asyncio.server as ws_server
 
         self._stop_event = asyncio.Event()
+        bind_attempts = 0
         while not self._stop_event.is_set():
             try:
                 async with ws_server.serve(
@@ -222,11 +301,20 @@ class DataStreamingServer:
                     compression=None, max_size=None,
                 ) as server:
                     self._server = server
+                    bind_attempts = 0
                     logger.info("data server listening on %s:%d", self.host, self.port)
                     await self._stop_event.wait()
             except OSError as e:
-                logger.error("server bind failed (%s); retrying in 1s", e)
-                await asyncio.sleep(1.0)
+                bind_attempts += 1
+                if bind_attempts >= self.BIND_MAX_ATTEMPTS:
+                    raise RuntimeError(
+                        f"data server could not bind {self.host}:{self.port}"
+                        f" after {bind_attempts} attempts: {e}") from e
+                delay = backoff_delay(bind_attempts, self.BIND_BASE_DELAY_S,
+                                      self.BIND_MAX_DELAY_S, jitter=0.25)
+                logger.error("server bind failed (%s); retry %d/%d in %.1fs",
+                             e, bind_attempts, self.BIND_MAX_ATTEMPTS, delay)
+                await asyncio.sleep(delay)
 
     async def stop(self) -> None:
         for st in list(self.display_clients.values()):
@@ -557,7 +645,12 @@ class DataStreamingServer:
         if st.display_id == "primary":
             self.broadcast(message)
         elif st.ws:
-            await st.ws.send(message)
+            try:
+                await st.ws.send(message)
+            except Exception:
+                # a dead secondary socket must not crash the (supervised)
+                # restart that is trying to recover its display
+                logger.debug("reset notify failed for %s", st.display_id)
 
     # ------------------------------------------------------------------
     # capture / encode pipeline per display
@@ -579,18 +672,44 @@ class DataStreamingServer:
     async def _start_display_locked(self, st: DisplayState) -> None:
         if st.capture_task and not st.capture_task.done():
             return
-        # A crashed capture loop may leave a live backpressure task behind;
-        # tear both down so restarts never leak a ticking loop.
+        # A failed/finished supervisor may leave a live backpressure task
+        # behind; tear both down so restarts never leak a ticking loop.
         await self._stop_display_locked(st)
-        # The capture loop numbers frames from 1 again, so the client and the
-        # backpressure gate must drop their old frame-id horizon — otherwise
-        # desync = (1 - old_ack) mod 2^16 reads as a huge lag and wedges the
-        # gate closed (reference resets likewise, selkies.py:1119-1146).
-        await self._reset_frame_ids_and_notify(st)
-        st.capture_task = asyncio.create_task(self._capture_loop(st))
-        st.backpressure_task = asyncio.create_task(self._backpressure_loop(st))
+        st.failed = False          # an explicit restart clears the marker
+        st.wedge_faults = 0
+        st.ladder.fail_threshold = max(
+            1, int(self.settings.ladder_fail_threshold))
+        st.ladder.probe_after_s = int(self.settings.ladder_probe_ms) / 1000.0
+        fps = st.bp.framerate or 60.0
+        wd_frames = int(self.settings.watchdog_frames)
+        watchdog_s = (max(0.5, wd_frames / max(1.0, fps))
+                      if wd_frames > 0 else None)
+        max_restarts = int(self.settings.supervisor_max_restarts)
+        window_s = float(int(self.settings.supervisor_restart_window_s))
+        st.supervisor = Supervisor(
+            f"capture:{st.display_id}",
+            lambda: self._capture_loop(st),
+            max_restarts=max_restarts,
+            restart_window_s=window_s,
+            watchdog_timeout_s=watchdog_s,
+            on_event=lambda kind, info:
+                self._on_supervisor_event(st, kind, info),
+        )
+        st.bp_supervisor = Supervisor(
+            f"backpressure:{st.display_id}",
+            lambda: self._backpressure_loop(st),
+            max_restarts=max_restarts,
+            restart_window_s=window_s,
+            on_event=lambda kind, info:
+                self._on_supervisor_event(st, kind, info),
+        )
+        st.capture_task = asyncio.create_task(st.supervisor.run())
+        st.backpressure_task = asyncio.create_task(st.bp_supervisor.run())
 
     async def _stop_display_locked(self, st: DisplayState) -> None:
+        """Exception-safe teardown: cancel BOTH tasks even if the first
+        cancellation raises, and always close the encoder adapter so worker
+        threads never leak across reconfigures."""
         for attr in ("capture_task", "backpressure_task"):
             task = getattr(st, attr)
             if task and not task.done():
@@ -599,68 +718,213 @@ class DataStreamingServer:
                     await task
                 except asyncio.CancelledError:
                     pass
+                except Exception:
+                    logger.exception("%s teardown for %s raised",
+                                     attr, st.display_id)
             setattr(st, attr, None)
+        st.supervisor = None
+        st.bp_supervisor = None
+        encoder, st.encoder = st.encoder, None
+        if encoder is not None:
+            close = getattr(encoder, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    logger.exception("encoder close for %s raised",
+                                     st.display_id)
 
     async def _capture_loop(self, st: DisplayState) -> None:
-        """Source frames → pipelined TPU encode → stripe broadcast."""
-        import websockets
+        """Source frames → pipelined TPU encode → stripe broadcast.
 
+        One *supervised* run (st.supervisor owns restarts): exceptions
+        propagate to the supervisor instead of being swallowed here, with
+        encoder-path failures wrapped in :class:`EncoderFault` so they step
+        the degradation ladder. The loop returns cleanly when the ladder
+        rung changes under it — the supervisor then restarts it, which
+        rebuilds the encoder at the new rung.
+        """
+        sup = st.supervisor
+        faults = self.faults
         fps = st.bp.framerate or 60.0
-        encoder = self._acquire_mesh_encoder(st, fps)
+        rung = st.ladder.rung
+        # The capture loop numbers frames from 1 again on EVERY (re)start —
+        # supervised crash restarts included — so the client and the
+        # backpressure gate must drop their old frame-id horizon; otherwise
+        # desync = (1 - old_ack) mod 2^16 reads as a huge lag and wedges the
+        # gate closed (reference resets likewise, selkies.py:1119-1146).
+        await self._reset_frame_ids_and_notify(st)
+        encoder = None
+        if rung == "device":
+            encoder = self._acquire_mesh_encoder(st, fps)
         if encoder is None:
+            overrides = dict(st.overrides)
+            if rung == "host":
+                overrides["tpu_entropy"] = "host"
+            elif rung == "jpeg":
+                overrides["encoder"] = "jpeg"
+                overrides["tpu_entropy"] = "host"
             try:
-                encoder = self.encoder_factory(
-                    st.width, st.height, self.settings, st.overrides)
-            except TypeError:  # factory without overrides support (tests)
-                encoder = self.encoder_factory(
-                    st.width, st.height, self.settings)
+                try:
+                    encoder = self.encoder_factory(
+                        st.width, st.height, self.settings, overrides)
+                except TypeError:  # factory without overrides support
+                    encoder = self.encoder_factory(
+                        st.width, st.height, self.settings)
+            except Exception as e:
+                # construction-time device sickness must step the ladder
+                # like any other encoder failure — otherwise a broken
+                # device tier is retried forever and never degrades
+                raise EncoderFault(
+                    f"encoder construction failed: {e!r}") from e
+        if getattr(encoder, "metrics", False) is None:
+            encoder.metrics = self.metrics
+        if hasattr(encoder, "on_error"):
+            # encode errors harvested off-loop (worker thread futures) feed
+            # the same ladder as loop-crashing EncoderFaults
+            encoder.on_error = lambda exc: st.ladder.record_failure()
         st.encoder = encoder
+        source = None
         try:
-            source = self.source_factory(st.width, st.height, fps,
-                                         x=st.x, y=st.y)
-        except TypeError:  # factory without offset support (tests, custom)
-            source = self.source_factory(st.width, st.height, fps)
-        source.start()
-        frame_id = 0
-        interval = 1.0 / fps
-        next_tick = time.monotonic()
-        logger.info("capture loop started for %s (%dx%d@%g)",
-                    st.display_id, st.width, st.height, fps)
-        try:
+            if sup is not None:
+                sup.beat()   # encoder construction counts as progress
+            try:
+                source = self.source_factory(st.width, st.height, fps,
+                                             x=st.x, y=st.y)
+            except TypeError:  # factory without offset support (tests)
+                source = self.source_factory(st.width, st.height, fps)
+            source.start()
+            frame_id = 0
+            interval = 1.0 / fps
+            next_tick = time.monotonic()
+            #: ticks whose harvest surfaced encoder errors without the
+            #: ladder stepping (i.e. at the bottom rung) — after the
+            #: ladder's own threshold, force a supervised rebuild rather
+            #: than streaming nothing forever
+            error_ticks = 0
+            #: a pipeline that stops ACCEPTING submits and harvesting
+            #: anything is wedged even though the loop itself still ticks
+            #: (e.g. a dead mesh worker); generous deadline so first-use
+            #: jit compiles never read as a wedge
+            wedge_s = None
+            if sup is not None and sup.watchdog_timeout_s is not None:
+                wedge_s = max(4.0 * sup.watchdog_timeout_s, 30.0)
+            accepted_at = time.monotonic()
+            logger.info("capture loop started for %s (%dx%d@%g, rung=%s)",
+                        st.display_id, st.width, st.height, fps, rung)
             while True:
+                if sup is not None:
+                    sup.beat()
+                faults.maybe_raise("capture.raise")
+                await faults.maybe_hang("capture.stall")
+                # clean-probe evidence for the ladder: the tick must have
+                # actually exercised the encoder (submit or delivery) AND
+                # harvested no new errors (on_error bumps failures_total
+                # from inside try_submit/poll for the threaded adapter)
+                failures_before = st.ladder.failures_total
+                progressed = False
+                accepted = True     # "no submit attempted" is not a wedge
                 if st.bp.send_enabled:
                     frame = source.next_frame()
                     if frame is not None:
                         # never block the shared event loop: drop when full
-                        submit = getattr(encoder, "try_submit", encoder.submit)
-                        submit(frame)
-                for _seq, stripes in encoder.poll():
+                        try_submit = getattr(encoder, "try_submit", None)
+                        try:
+                            faults.maybe_raise("encode.raise")
+                            if try_submit is not None:
+                                # None = dropped (pipeline full): fine in
+                                # bursts, but sustained non-acceptance with
+                                # no harvests below means a wedged pipeline
+                                accepted = try_submit(frame) is not None
+                            else:
+                                encoder.submit(frame)
+                        except Exception as e:
+                            raise EncoderFault(
+                                f"encoder submit failed: {e!r}") from e
+                        progressed = True
+                await faults.maybe_hang("fetch.hang")
+                try:
+                    harvested = encoder.poll()
+                except Exception as e:
+                    raise EncoderFault(
+                        f"encoder poll failed: {e!r}") from e
+                if sup is not None:
+                    # submit/poll can legitimately block the loop for one
+                    # long stretch (first-use jit compile); beating after
+                    # them keeps that from reading as a stall
+                    sup.beat()
+                for _seq, stripes in harvested:
                     if not stripes:
                         continue
+                    progressed = True
                     frame_id = FrameId.next(frame_id)
                     viewers = self._viewers_of(st.display_id)
                     for s in stripes:
                         chunk = self._pack_stripe(frame_id, s, encoder)
                         if viewers:
-                            websockets.broadcast(viewers, chunk)
+                            _ws_broadcast(viewers, chunk)
                             self.bytes_sent += len(chunk) * len(viewers)
                     st.bp.on_frame_sent(frame_id)
+                if any(stripes for _seq, stripes in harvested):
+                    accepted = True
+                now = time.monotonic()
+                if accepted:
+                    accepted_at = now
+                elif wedge_s is not None and now - accepted_at > wedge_s:
+                    # loop ticks, nothing moves: dead mesh worker / wedged
+                    # pipeline — force_step tells the event handler to step
+                    # the ladder immediately (one accounting site; a
+                    # consecutive count would be reset by each restart's
+                    # first accepted submit and never escalate)
+                    raise EncoderFault(
+                        f"pipeline wedged: no accepted submits or harvests "
+                        f"for {now - accepted_at:.1f}s", force_step=True)
+                if st.ladder.failures_total > failures_before:
+                    # errors surfaced off-loop this tick (threaded-adapter
+                    # harvest); if the ladder can no longer step down, a
+                    # persistently sick bottom rung must still force a
+                    # supervised rebuild instead of streaming nothing
+                    error_ticks += 1
+                    if (error_ticks >= st.ladder.fail_threshold
+                            and st.ladder.rung == rung):
+                        raise EncoderFault(
+                            f"persistent encode errors at rung {rung} "
+                            f"({error_ticks} consecutive error ticks)")
+                elif progressed:
+                    error_ticks = 0
+                    if st.ladder.record_success():
+                        logger.info("display %s probed back up to rung %s",
+                                    st.display_id, st.ladder.rung)
+                if st.ladder.rung != rung:
+                    # rung changed under us (off-loop step-down via
+                    # on_error, or the probe above): exit cleanly; the
+                    # supervisor restarts with the new rung's encoder
+                    self._broadcast_health()
+                    return
+                if st.ws is not None and faults.should_fire("ws.drop"):
+                    self._spawn_background(st.ws.close(),
+                                           f"ws.drop:{st.display_id}")
                 next_tick += interval
                 delay = next_tick - time.monotonic()
                 if delay < -1.0:  # fell badly behind; resynchronize
                     next_tick = time.monotonic()
                     delay = 0.0
                 await asyncio.sleep(max(0.0, delay))
-        except asyncio.CancelledError:
-            raise
-        except Exception:
-            logger.exception("capture loop for %s crashed", st.display_id)
         finally:
-            source.stop()
+            if source is not None:
+                try:
+                    source.stop()
+                except Exception:
+                    logger.exception("source stop for %s raised",
+                                     st.display_id)
             st.encoder = None
             close = getattr(encoder, "close", None)
             if close is not None:
-                close()
+                try:
+                    close()
+                except Exception:
+                    logger.exception("encoder close for %s raised",
+                                     st.display_id)
 
     @staticmethod
     def _pack_stripe(frame_id: int, s, encoder) -> bytes:
@@ -743,9 +1007,127 @@ class DataStreamingServer:
         return facade
 
     async def _backpressure_loop(self, st: DisplayState) -> None:
+        sup = st.bp_supervisor
         while True:
             await asyncio.sleep(CHECK_INTERVAL_S)
+            if sup is not None:
+                sup.beat()
             st.bp.evaluate()
+
+    # ------------------------------------------------------------------
+    # supervision events + health feed (ISSUE 2)
+
+    def _spawn_background(self, coro, name: str) -> None:
+        """Run a fire-and-forget coroutine with a held reference and
+        logged (not warned-at-GC) exceptions."""
+        async def runner():
+            try:
+                await coro
+            except Exception:
+                logger.debug("background task %s failed", name,
+                             exc_info=True)
+        task = asyncio.create_task(runner())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    def _on_supervisor_event(self, st: DisplayState, kind: str,
+                             info: Any) -> None:
+        """Metrics + ladder + health fan-out for supervisor lifecycle
+        events (runs on the event loop; must never raise)."""
+        if kind == "failure" and isinstance(info, EncoderFault):
+            force_step = getattr(info, "force_step", False)
+            stepped = (st.ladder.force_step_down() if force_step
+                       else st.ladder.record_failure())
+            if stepped:
+                st.wedge_faults = 0
+                logger.warning("display %s degraded to rung %s",
+                               st.display_id, st.ladder.rung)
+                if st.supervisor is not None:
+                    # the ladder absorbed this failure streak; judge the
+                    # new rung against a fresh budget, or probe cycles
+                    # would terminally fail a healthy degraded display
+                    st.supervisor.forgive()
+            elif force_step:
+                # wedged with nowhere left to degrade: each rebuild of a
+                # hung encoder may abandon a blocked worker thread, so
+                # bound the cycle instead of leaking threads forever
+                st.wedge_faults += 1
+                if st.wedge_faults >= 3:
+                    logger.error(
+                        "display %s wedged %d times at the bottom rung; "
+                        "marking failed", st.display_id, st.wedge_faults)
+                    kind = "failed"
+        if self.metrics is not None:
+            if kind == "restart":
+                self.metrics.inc_supervisor_restart()
+            elif kind == "watchdog":
+                self.metrics.inc_watchdog_restart()
+        if kind == "failed":
+            # a terminally failed capture pipeline must not leave its
+            # sibling backpressure loop ticking forever; tear the display
+            # down from OUTSIDE the supervisor task that emitted the event
+            # (stopping it inline would await the task we are inside of)
+            st.failed = True
+            self._spawn_background(self._teardown_failed_display(st),
+                                   f"teardown-failed:{st.display_id}")
+        self._broadcast_health()
+
+    async def _teardown_failed_display(self, st: DisplayState) -> None:
+        async with st.lock:
+            if not st.failed:
+                # an explicit START_VIDEO/reconfigure restarted the display
+                # before this queued teardown ran — it is healthy again and
+                # must not be torn back down
+                return
+            await self._stop_display_locked(st)
+
+    def _failed_displays(self) -> int:
+        return sum(1 for d in self.display_clients.values()
+                   if d.failed or (d.supervisor is not None
+                                   and d.supervisor.state == FAILED))
+
+    def _health_payload(self) -> str:
+        """The ``system,health`` wire message: per-display supervision,
+        watchdog, and degradation-ladder state."""
+        displays: Dict[str, Any] = {}
+        for did, st in self.display_clients.items():
+            sup = st.supervisor.stats() if st.supervisor is not None else {}
+            d: Dict[str, Any] = {
+                "rung": st.ladder.rung,
+                "ladder": st.ladder.state(),
+                "failed": st.failed,
+                "supervisor": sup.get("state",
+                                      "failed" if st.failed else "idle"),
+                "restarts": sup.get("restarts_total", 0),
+                "failures": sup.get("failures_total", 0),
+                "watchdog_restarts": sup.get("watchdog_restarts_total", 0),
+            }
+            enc = st.encoder
+            if enc is not None and hasattr(enc, "stats"):
+                try:
+                    est = enc.stats()
+                except Exception:
+                    est = {}
+                d["frames_dropped"] = est.get("frames_dropped", 0)
+                d["encode_errors"] = est.get("encode_errors", 0)
+            displays[did] = d
+        return pack_system_health(displays)
+
+    def _publish_health_metrics(self) -> None:
+        """Recompute the health gauges from current state — recovery and
+        display removal must clear them, not only events raise them."""
+        if self.metrics is None:
+            return
+        levels = [d.ladder.level for d in self.display_clients.values()]
+        self.metrics.set_degradation_rung(max(levels) if levels else 0)
+        self.metrics.set_failed_displays(self._failed_displays())
+
+    def _broadcast_health(self) -> None:
+        try:
+            self._publish_health_metrics()
+            self.broadcast(self._health_payload())
+        except Exception:
+            logger.exception("health broadcast failed")
 
     async def set_framerate(self, fps: float) -> None:
         """Apply a new target framerate to every active display.
@@ -817,6 +1199,12 @@ class DataStreamingServer:
         while True:
             await asyncio.sleep(STATS_INTERVAL_S)
             try:
+                if self.metrics is not None:
+                    # aggregated ONCE per tick here, not per display loop
+                    self.metrics.set_backpressured(sum(
+                        1 for d in self.display_clients.values()
+                        if not d.bp.send_enabled))
+                    self._publish_health_metrics()
                 stats = self._collect_system_stats()
                 self.broadcast(json.dumps(stats))
                 net = {
@@ -837,8 +1225,18 @@ class DataStreamingServer:
                         for coord in self.mesh_coordinators.values())
                     net["mesh_solo_fallbacks"] = \
                         self.mesh_stats["solo_fallback"]
+                    # per-shard fault accounting (ISSUE 2): failed ticks
+                    # and worker re-spawns are health, not noise
+                    net["mesh_tick_errors"] = sum(
+                        coord.tick_errors_total
+                        for coord in self.mesh_coordinators.values())
+                    net["mesh_worker_restarts"] = sum(
+                        coord.worker_restarts_total
+                        for coord in self.mesh_coordinators.values())
                 prev_bytes = self.bytes_sent
                 self.broadcast(json.dumps(net))
+                if self.display_clients:
+                    self._broadcast_health()
                 tpu = self._collect_tpu_stats()
                 if tpu:
                     self.broadcast(json.dumps(tpu))

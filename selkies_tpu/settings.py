@@ -323,6 +323,25 @@ SETTING_DEFINITIONS: List[Spec] = [
     BoolSpec("enable_player3", True, "Gamepad player 3 link."),
     BoolSpec("enable_player4", True, "Gamepad player 4 link."),
 
+    # --- Robustness / supervision (server-only; docs/robustness.md) ---
+    StrSpec("tpu_faults", "", "Comma list of fault points to arm for chaos "
+            "runs and tests (grammar: name[*count][=arg]; see "
+            "docs/robustness.md).", server_only=True),
+    IntSpec("supervisor_max_restarts", 6, "Failure/watchdog restarts allowed "
+            "per display loop within the restart window before the display "
+            "is marked failed.", server_only=True),
+    IntSpec("supervisor_restart_window_s", 60, "Sliding window (seconds) the "
+            "supervisor restart budget is counted over.", server_only=True),
+    IntSpec("watchdog_frames", 600, "Frame intervals without capture-loop "
+            "progress before the watchdog cancels and restarts the pipeline "
+            "(0 disables the watchdog).", server_only=True),
+    IntSpec("ladder_fail_threshold", 3, "Consecutive encoder failures before "
+            "the degradation ladder steps down a rung "
+            "(device -> host -> jpeg).", server_only=True),
+    IntSpec("ladder_probe_ms", 15000, "Clean-run milliseconds at a degraded "
+            "rung before the ladder probes back up one rung.",
+            server_only=True),
+
     # --- TPU-native additions (server-only) ---
     IntSpec("tpu_stripe_height", 64, "Encoder stripe height in rows (multiple of 16).",
             server_only=True),

@@ -362,6 +362,59 @@ async def test_resize_resets_frame_ids(tmp_path):
 
 
 @pytest.mark.anyio
+async def test_reconnect_resyncs_frame_ids_and_keyframe(tmp_path):
+    """Satellite (ISSUE 2): client disconnect mid-stream then reconnect
+    exercises _reset_frame_ids_and_notify — frame IDs restart at 1, the
+    rebuilt encoder leads with a keyframe, and the reset precedes media."""
+    server, app, encoders = make_server(tmp_path)
+    srv, port = await start_on_free_port(server)
+    try:
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws:
+            await handshake(ws)
+            await ws.send('SETTINGS,' + json.dumps({
+                "displayId": "primary", "initialClientWidth": 320,
+                "initialClientHeight": 240, "framerate": 30}))
+            seen = 0
+            while seen < 3:
+                m = await asyncio.wait_for(ws.recv(), 5)
+                if isinstance(m, bytes):
+                    seen += 1
+        # socket closed: the handler tears the display down
+        for _ in range(100):
+            if "primary" not in server.display_clients:
+                break
+            await asyncio.sleep(0.02)
+        assert "primary" not in server.display_clients
+        n_enc = len(encoders)
+
+        async with websockets.connect(f"ws://127.0.0.1:{port}") as ws2:
+            await handshake(ws2)
+            await ws2.send('SETTINGS,' + json.dumps({
+                "displayId": "primary", "initialClientWidth": 320,
+                "initialClientHeight": 240, "framerate": 30}))
+            saw_reset = False
+            frame = None
+            while frame is None:
+                m = await asyncio.wait_for(ws2.recv(), 5)
+                if isinstance(m, str) and m.startswith("PIPELINE_RESETTING"):
+                    saw_reset = True
+                elif isinstance(m, bytes):
+                    frame = m
+            assert saw_reset, "media arrived before PIPELINE_RESETTING"
+            f = unpack_binary(frame)
+            assert isinstance(f, VideoStripe)
+            assert f.frame_id == 1
+            assert f.is_key
+            assert len(encoders) > n_enc       # rebuilt, not reused
+            st = server.display_clients["primary"]
+            assert st.bp.last_sent_frame_id < 100
+            assert st.bp.send_enabled
+    finally:
+        await server.stop()
+        srv.close()
+
+
+@pytest.mark.anyio
 async def test_multi_display_layout_drives_xrandr(tmp_path, monkeypatch):
     """Two displays attach → the server computes the extended layout, sets
     capture offsets, and (with xrandr 'available') issues the monitor

@@ -1,0 +1,207 @@
+#!/usr/bin/env python3
+"""Chaos harness: a synthetic-capture session under random fault injection.
+
+Runs an in-process ``DataStreamingServer`` session (real encoder factory,
+synthetic capture, fake in-process websocket client — no network, no
+``websockets`` package needed) while randomly arming fault points from the
+``SELKIES_TPU_FAULTS`` menu, then asserts the session is still alive and
+streaming once the faults stop: supervised restarts happened, no display
+reached the terminal ``failed`` state, and frames flow after the last
+fault. docs/robustness.md describes the subsystems this exercises.
+
+Usage::
+
+    python tools/chaos_run.py --duration 10 --seed 0
+    python tools/chaos_run.py --duration 60 --fps 60 --width 640 --height 480
+
+Also run (shortened) as the ``slow``-marked test
+``tests/test_robustness.py::test_chaos_session_survives_fault_storm``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: (point, times, arg) entries the chaos loop draws from — short hangs so
+#: a single run exercises both the hang-recovery and the watchdog paths
+FAULT_MENU = (
+    ("capture.raise", 1, None),
+    ("capture.stall", 1, "0.4"),
+    ("encode.raise", 1, None),
+    ("fetch.hang", 1, "0.4"),
+    ("ws.drop", 1, None),
+)
+
+
+from selkies_tpu.robustness.testing import InProcessClient as _ChaosClient  # noqa: E402
+
+
+async def chaos_session(duration_s: float = 10.0, seed: int = 0,
+                        width: int = 160, height: int = 128,
+                        fps: float = 30.0) -> dict:
+    """Run one chaos session; returns the survival report."""
+    from selkies_tpu.server.app import StreamingApp
+    from selkies_tpu.server.data_server import (DataStreamingServer,
+                                                default_encoder_factory)
+    from selkies_tpu.settings import Settings
+
+    env = {
+        "SELKIES_PORT": "0",
+        "SELKIES_AUDIO_ENABLED": "false",
+        # generous budget: chaos injects faults far faster than production
+        "SELKIES_SUPERVISOR_MAX_RESTARTS": "1000",
+        "SELKIES_SUPERVISOR_RESTART_WINDOW_S": "60",
+        "SELKIES_WATCHDOG_FRAMES": str(int(fps * 2)),   # 2 s deadline
+        "SELKIES_LADDER_FAIL_THRESHOLD": "3",
+        "SELKIES_LADDER_PROBE_MS": "2000",
+    }
+    settings = Settings(argv=[], env=env)
+
+    # warm the jit cache outside the session so a cold compile is not
+    # misread as a stall by the watchdog on slow CPUs
+    warm = default_encoder_factory(width, height, settings, {})
+    warm.submit(np.zeros((height, width, 3), np.uint8))
+    warm.flush()
+    close = getattr(warm, "close", None)
+    if close:
+        close()
+
+    app = StreamingApp(settings)
+    server = DataStreamingServer(settings, app=app, host="127.0.0.1")
+    app.data_server = server
+    rng = random.Random(seed)
+    reconnects = 0
+    #: supervisors (and their counters) die with their display when ws.drop
+    #: churns the client, so totals accumulate across incarnations: the
+    #: chaos loop OBSERVES the live counters continuously and COMMITS the
+    #: last observation when an incarnation ends (a display torn down
+    #: between observations loses at most the final fraction of a second)
+    totals = {"restarts": 0, "failures": 0, "watchdog_restarts": 0}
+    transitions = []
+    last_obs = {}
+
+    def observe():
+        nonlocal last_obs
+        st = server.display_clients.get("primary")
+        if st is not None and st.supervisor is not None:
+            sup = st.supervisor.stats()
+            last_obs = {
+                "restarts": sup["restarts_total"],
+                "failures": sup["failures_total"],
+                "watchdog_restarts": sup["watchdog_restarts_total"],
+                "transitions": list(st.ladder.transitions),
+            }
+
+    def commit():
+        nonlocal last_obs
+        for k in totals:
+            totals[k] += last_obs.get(k, 0)
+        transitions.extend(last_obs.get("transitions", []))
+        last_obs = {}
+
+    async def connect():
+        ws = _ChaosClient()
+        task = asyncio.create_task(server.ws_handler(ws))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(ws.sent) < 2:
+            await asyncio.sleep(0.01)
+        ws.feed("SETTINGS," + json.dumps({
+            "displayId": "primary",
+            "initialClientWidth": width, "initialClientHeight": height,
+            "framerate": fps}))
+        return ws, task
+
+    async def reap(ws, task):
+        await ws.close()
+        try:
+            await asyncio.wait_for(task, 5.0)
+        except asyncio.TimeoutError:
+            task.cancel()
+
+    ws, task = await connect()
+    injected = []
+    t_end = time.monotonic() + duration_s
+    try:
+        while time.monotonic() < t_end:
+            await asyncio.sleep(rng.uniform(0.3, 0.7))
+            observe()
+            if ws.closed:                     # ws.drop churned the client
+                commit()
+                await reap(ws, task)
+                ws, task = await connect()
+                reconnects += 1
+            point, times, arg = FAULT_MENU[rng.randrange(len(FAULT_MENU))]
+            server.faults.arm(point, times=times, arg=arg)
+            injected.append(point)
+
+        # quiesce and verify recovery: no new faults, frames must flow
+        server.faults.disarm()
+        recovered = False
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            observe()
+            if ws.closed:
+                commit()
+                await reap(ws, task)
+                ws, task = await connect()
+                reconnects += 1
+            n0 = ws.n_frames()
+            await asyncio.sleep(0.5)
+            if not ws.closed and ws.n_frames() > n0:
+                recovered = True
+                break
+
+        observe()
+        commit()
+        st = server.display_clients.get("primary")
+        report = {
+            "duration_s": duration_s,
+            "seed": seed,
+            "injected": injected,
+            "reconnects": reconnects,
+            "restarts": totals["restarts"],
+            "failures": totals["failures"],
+            "watchdog_restarts": totals["watchdog_restarts"],
+            "ladder_transitions": transitions,
+            "rung": st.ladder.rung if st else None,
+            "failed_displays": server._failed_displays(),
+            "frames_delivered": ws.n_frames(),
+            "alive": recovered and server._failed_displays() == 0,
+        }
+        return report
+    finally:
+        await reap(ws, task)
+        await server.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--duration", type=float, default=10.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--width", type=int, default=160)
+    p.add_argument("--height", type=int, default=128)
+    p.add_argument("--fps", type=float, default=30.0)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.ERROR)
+    report = asyncio.run(chaos_session(
+        duration_s=args.duration, seed=args.seed,
+        width=args.width, height=args.height, fps=args.fps))
+    print(json.dumps(report, indent=2))
+    return 0 if report["alive"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
