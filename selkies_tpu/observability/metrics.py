@@ -90,6 +90,35 @@ class Metrics:
             "failed_displays", "Displays whose supervisor exhausted its "
             "restart budget (terminal failed state)",
             registry=self.registry)
+        # ISSUE 3: wire-edge hardening — malformed/floody/stalled clients
+        # must be visible as first-class series, not debug log lines
+        self.protocol_errors = Counter(
+            "protocol_errors_total", "Client messages dropped by the "
+            "per-message exception boundary (malformed frames, spoofed "
+            "server verbs, handler crashes)", registry=self.registry)
+        self.rate_limited = Counter(
+            "rate_limited_total", "Client messages dropped by per-class "
+            "token-bucket rate limiting", ("klass",),
+            registry=self.registry)
+        self.upload_paced = Counter(
+            "upload_paced_total", "Upload messages accepted after a "
+            "pacing sleep (byte-rate smoothing; nothing was dropped)",
+            registry=self.registry)
+        self.sessions_rejected = Counter(
+            "sessions_rejected_total", "Connections/displays refused by "
+            "admission control (max_clients, max_displays, load shedding)",
+            registry=self.registry)
+        self.slow_client_evictions = Counter(
+            "slow_client_evictions_total", "Clients disconnected after "
+            "sustained send-queue overflow (KILL slow_consumer)",
+            registry=self.registry)
+        self.send_queue_depth = Gauge(
+            "send_queue_depth", "Deepest per-client bounded send queue",
+            registry=self.registry)
+        self.reconfigure_coalesced = Counter(
+            "reconfigure_coalesced_total", "Resize/SETTINGS requests "
+            "absorbed into an already-scheduled display reconfiguration",
+            registry=self.registry)
         self.clients = Gauge("connected_clients", "WebSocket clients",
                              registry=self.registry)
         self.backpressured = Gauge(
@@ -156,6 +185,34 @@ class Metrics:
     def set_failed_displays(self, n: int) -> None:
         if HAVE_PROM:
             self.failed_displays.set(n)
+
+    def inc_protocol_errors(self, n: int = 1) -> None:
+        if HAVE_PROM and n > 0:
+            self.protocol_errors.inc(n)
+
+    def inc_rate_limited(self, klass: str, n: int = 1) -> None:
+        if HAVE_PROM and n > 0:
+            self.rate_limited.labels(klass=klass).inc(n)
+
+    def inc_upload_paced(self, n: int = 1) -> None:
+        if HAVE_PROM and n > 0:
+            self.upload_paced.inc(n)
+
+    def inc_sessions_rejected(self) -> None:
+        if HAVE_PROM:
+            self.sessions_rejected.inc()
+
+    def inc_slow_client_eviction(self) -> None:
+        if HAVE_PROM:
+            self.slow_client_evictions.inc()
+
+    def set_send_queue_depth(self, n: int) -> None:
+        if HAVE_PROM:
+            self.send_queue_depth.set(n)
+
+    def inc_reconfigure_coalesced(self, n: int = 1) -> None:
+        if HAVE_PROM and n > 0:
+            self.reconfigure_coalesced.inc(n)
 
     def set_clients(self, n: int) -> None:
         if HAVE_PROM:

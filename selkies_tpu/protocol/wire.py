@@ -52,6 +52,15 @@ class ClientBinaryType(enum.IntEnum):
 _U16 = struct.Struct(">H")
 
 
+class ProtocolError(ValueError):
+    """A frame that violates the client→server wire contract.
+
+    Subclasses :class:`ValueError` so pre-existing callers that catch
+    ``ValueError`` keep working; the server's per-message exception
+    boundary counts these against the connection's error budget.
+    """
+
+
 # --------------------------------------------------------------------------
 # Frame-id arithmetic (u16 wraparound)
 
@@ -191,15 +200,23 @@ def pack_file_chunk(chunk: bytes) -> bytes:
 
 
 def unpack_client_binary(data: bytes) -> Union[FileChunk, MicChunk]:
-    """Demux a client → server binary frame (1-byte header)."""
+    """Demux a client → server binary frame (1-byte header).
+
+    This is a trust boundary: a server→client type byte (0x00/0x03/0x04)
+    arriving *from* a client is a wrong-direction frame and raises
+    :class:`ProtocolError`, same as any unknown type.
+    """
     if not data:
-        raise ValueError("empty binary frame")
+        raise ProtocolError("empty binary frame")
     t = data[0]
     if t == ClientBinaryType.FILE_CHUNK:
         return FileChunk(payload=bytes(data[1:]))
     if t == ClientBinaryType.MIC_PCM:
         return MicChunk(payload=bytes(data[1:]))
-    raise ValueError(f"unknown client binary type 0x{t:02x}")
+    if t in BinaryType._value2member_map_:
+        raise ProtocolError(
+            f"server->client type byte 0x{t:02x} in a client frame")
+    raise ProtocolError(f"unknown client binary type 0x{t:02x}")
 
 
 def unpack_binary(
@@ -305,19 +322,50 @@ _SIMPLE_VERBS = frozenset(
 
 _COLON_VERBS = ("FILE_UPLOAD_START", "FILE_UPLOAD_END", "FILE_UPLOAD_ERROR")
 
+#: server → client verbs that must never be accepted *from* a client: the
+#: parser is a trust boundary, and before the exact-delimiter tightening
+#: these fell through toward the input handler when spoofed by a client
+_SERVER_ONLY_VERBS = frozenset({
+    "KILL", "PIPELINE_RESETTING", "MODE",
+    "VIDEO_STARTED", "VIDEO_STOPPED", "AUDIO_STARTED", "AUDIO_STOPPED",
+})
+
+
+def _is_verb(message: str, verb: str, delims: str = " ,") -> bool:
+    """Exact verb-plus-delimiter match: ``verb`` alone, or ``verb``
+    immediately followed by one of ``delims`` — never a prefix match, so
+    ``CLIENT_FRAME_ACKjunk`` is NOT ``CLIENT_FRAME_ACK``."""
+    if message == verb:
+        return True
+    return (message.startswith(verb)
+            and len(message) > len(verb)
+            and message[len(verb)] in delims)
+
 
 def parse_text_message(message: str) -> TextMessage:
-    """Parse a client text message into (verb, args).
+    """Parse a client→server text message into (verb, args).
 
     The grammar is positional and comma/space/colon-delimited depending on the
     verb family; this mirrors how the reference server branches on prefixes
     (selkies.py:1843-2300) but centralizes it in one typed parser.
+
+    Trust-boundary rules (this parses *hostile* input):
+
+    * verbs match exactly up to their delimiter — ``CLIENT_FRAME_ACKjunk``
+      is an unknown verb, not an ACK;
+    * server→client verbs (``KILL``, ``PIPELINE_RESETTING``, ``MODE``,
+      ``VIDEO_STARTED``/…) raise :class:`ProtocolError` instead of falling
+      through toward the input handler.
     """
+    for verb in _SERVER_ONLY_VERBS:
+        if _is_verb(message, verb):
+            raise ProtocolError(
+                f"server->client verb {verb!r} received from a client")
     if message in _SIMPLE_VERBS:
         return TextMessage(message)
     if message.startswith("SETTINGS,"):
         return TextMessage("SETTINGS", json_body=message[len("SETTINGS,"):])
-    if message.startswith("CLIENT_FRAME_ACK"):
+    if _is_verb(message, "CLIENT_FRAME_ACK", " "):
         parts = message.split()
         return TextMessage("CLIENT_FRAME_ACK", tuple(parts[1:2]))
     for verb in _COLON_VERBS:
@@ -330,10 +378,7 @@ def parse_text_message(message: str) -> TextMessage:
                 path, _, msg = rest.partition(":")
                 return TextMessage(verb, (path, msg))
             return TextMessage(verb, (rest,))
-    if message.startswith("PIPELINE_RESETTING") or message.startswith("KILL"):
-        parts = message.split(None, 1)
-        return TextMessage(parts[0], tuple(parts[1:]))
-    if message.startswith("_f ") or message.startswith("_l "):
+    if _is_verb(message, "_f", " ") or _is_verb(message, "_l", " "):
         verb, _, val = message.partition(" ")
         return TextMessage(verb, (val,))
     if message.startswith("cmd,"):

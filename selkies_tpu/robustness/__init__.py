@@ -12,16 +12,28 @@ hiccups, capture stalls, and client churn (docs/robustness.md):
 * :class:`FaultInjector` — named fault points checked at the real call
   sites, armed via ``SELKIES_TPU_FAULTS`` so tests prove recovery
   end-to-end instead of assuming it.
+
+The wire-edge armor (docs/hardening.md) lives in :mod:`.ratelimit`:
+:class:`TokenBucket` / :class:`ConnectionGuard` per-class rate limiting
+and error budgets, and :class:`BoundedSendQueue` slow-consumer
+isolation — pure clock-injected policy the server wires to real
+connections.
 """
 
 from .faults import DEFAULT_HANG_S, POINTS, FaultInjected, FaultInjector
 from .ladder import RUNGS, DegradationLadder, EncoderFault
+from .ratelimit import (DEFAULT_LIMITS, MESSAGE_CLASSES, UPLOAD_VERB_COST,
+                        BoundedSendQueue, ConnectionGuard, TokenBucket,
+                        classify_verb, parse_limit_spec)
 from .supervisor import (BACKOFF, FAILED, IDLE, RUNNING, STOPPED, Supervisor,
                          backoff_delay)
 from .testing import InProcessClient
 
 __all__ = [
-    "BACKOFF", "DEFAULT_HANG_S", "DegradationLadder", "EncoderFault",
-    "FAILED", "FaultInjected", "FaultInjector", "IDLE", "InProcessClient",
-    "POINTS", "RUNGS", "RUNNING", "STOPPED", "Supervisor", "backoff_delay",
+    "BACKOFF", "BoundedSendQueue", "ConnectionGuard", "DEFAULT_HANG_S",
+    "DEFAULT_LIMITS", "DegradationLadder", "EncoderFault", "FAILED",
+    "FaultInjected", "FaultInjector", "IDLE", "InProcessClient",
+    "MESSAGE_CLASSES", "POINTS", "RUNGS", "RUNNING", "STOPPED", "Supervisor",
+    "TokenBucket", "UPLOAD_VERB_COST", "backoff_delay", "classify_verb",
+    "parse_limit_spec",
 ]

@@ -25,19 +25,26 @@ from typing import Any, Callable, Dict, Optional, Set, Tuple
 
 from ..protocol.wire import (
     FrameId,
+    ProtocolError,
     pack_full_frame,
     pack_h264_stripe,
     pack_jpeg_stripe,
     pack_system_health,
     parse_text_message,
+    unpack_client_binary,
 )
 from ..robustness import (
     FAILED,
+    UPLOAD_VERB_COST,
+    BoundedSendQueue,
+    ConnectionGuard,
     DegradationLadder,
     EncoderFault,
     FaultInjector,
     Supervisor,
     backoff_delay,
+    classify_verb,
+    parse_limit_spec,
 )
 from ..settings import SETTING_DEFINITIONS, Settings
 from .backpressure import CHECK_INTERVAL_S, BackpressureState
@@ -46,6 +53,16 @@ logger = logging.getLogger("selkies_tpu.server")
 
 STATS_INTERVAL_S = 5.0
 UPLOAD_DIR_ENV = "SELKIES_UPLOAD_DIR"
+
+#: largest accepted client display dimension: an unbounded resize request
+#: is a memory bomb (the capture source allocates width*height*3 per
+#: frame); 8192 covers 8K while keeping one frame under ~200 MB
+MAX_DISPLAY_DIM = 8192
+
+
+def _clamp_dim(v: int) -> int:
+    """Clamp a client-requested display dimension to [16, MAX] and even."""
+    return min(MAX_DISPLAY_DIM, max(16, int(v) & ~1))
 
 
 def _ws_broadcast(targets, message) -> None:
@@ -71,6 +88,52 @@ def _ws_broadcast(targets, message) -> None:
         import websockets
 
         websockets.broadcast(real, message)
+
+
+class _ClientSendQueue:
+    """Asyncio drainer around a :class:`BoundedSendQueue` for one client.
+
+    The fan-out path offers into the bounded queue (synchronous, never
+    blocks the capture loop); this drainer task awaits the transport's
+    real ``send`` so per-client flow control backs up into the queue —
+    where drop-oldest-video and the eviction verdict live — instead of
+    into the shared event loop."""
+
+    def __init__(self, ws, q: BoundedSendQueue, on_evict) -> None:
+        self.ws = ws
+        self.q = q
+        self.evicted = False
+        self._on_evict = on_evict
+        self._wake = asyncio.Event()
+        self.task = asyncio.create_task(self._drain())
+
+    def offer(self, message, control: bool) -> None:
+        self.q.offer(message, control=control)
+        self._wake.set()
+        if not self.evicted and self.q.should_evict:
+            self.evicted = True
+            self._on_evict(self)
+
+    async def _drain(self) -> None:
+        try:
+            while True:
+                await self._wake.wait()
+                self._wake.clear()
+                while True:
+                    message = self.q.pop()
+                    if message is None:
+                        break
+                    await self.ws.send(message)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # the connection died mid-send; ws_handler's cleanup owns the
+            # socket, the drainer just stops
+            logger.debug("send-queue drain ended", exc_info=True)
+
+    def close(self) -> None:
+        if self.task is not None and not self.task.done():
+            self.task.cancel()
 
 
 def upload_dir() -> str:
@@ -254,6 +317,35 @@ class DataStreamingServer:
         #: teardown) — referenced so they are neither GC'd mid-flight nor
         #: left to warn "exception was never retrieved"
         self._bg_tasks: Set[asyncio.Task] = set()
+        # --- wire-edge hardening (ISSUE 3, docs/hardening.md) ---
+        #: per-class rate limits; a bad rate_limits spec fails construction
+        #: loudly, like a bad fault spec
+        self._limits = parse_limit_spec(
+            str(getattr(settings, "rate_limits", "") or ""))
+        #: per-connection protocol armor (error budget + class buckets)
+        self._guards: Dict[Any, ConnectionGuard] = {}
+        #: per-client bounded send queues wrapped around the fan-out path
+        self._send_queues: Dict[Any, _ClientSendQueue] = {}
+        #: local mirrors of the edge metrics so behavior is assertable
+        #: without prometheus (rate_limited is per message class)
+        self.edge_stats: Dict[str, Any] = {
+            "protocol_errors": 0,
+            "rate_limited": {},
+            "upload_paced": 0,
+            "sessions_rejected": 0,
+            "slow_client_evictions": 0,
+            "reconfigure_runs": 0,
+            "reconfigure_coalesced": 0,
+        }
+        #: debounced/serialized display reconfiguration: a resize storm
+        #: coalesces into one stop-the-world reconfigure, not one per message
+        self._reconfig_task: Optional[asyncio.Task] = None
+        self._reconfig_dirty = False
+        #: admission-control load shedding (driven by sustained encoder
+        #: drops observed in the stats loop)
+        self._load_shedding = False
+        self._shed_strikes = 0
+        self._last_dropped_total = 0
 
     @property
     def mesh_coordinator(self):
@@ -265,9 +357,49 @@ class DataStreamingServer:
 
     def broadcast(self, message) -> None:
         if self.clients:
-            _ws_broadcast(self.clients, message)
+            self._fanout(self.clients, message)
             if isinstance(message, (bytes, bytearray)):
                 self.bytes_sent += len(message) * len(self.clients)
+
+    def _fanout(self, targets, message) -> None:
+        """Fan one message out through the per-client bounded send queues
+        (docs/hardening.md): text is control (never dropped), binary media
+        is droppable — a slow consumer converges to the live edge of the
+        stream or is evicted, and never stalls the capture loop. Targets
+        without a queue (added outside ws_handler, or mid-handshake) get
+        the direct transport broadcast."""
+        control = isinstance(message, str)
+        direct = []
+        for t in targets:
+            cq = self._send_queues.get(t)
+            if cq is None:
+                direct.append(t)
+            elif not cq.evicted:
+                cq.offer(message, control)
+        if direct:
+            _ws_broadcast(direct, message)
+
+    def _evict_slow_client(self, cq: _ClientSendQueue) -> None:
+        """Sustained send-queue overflow: this consumer is not keeping up
+        and dropping video no longer helps — close its one socket (with a
+        best-effort KILL) so its backlog stops costing memory."""
+        self.edge_stats["slow_client_evictions"] += 1
+        if self.metrics is not None:
+            self.metrics.inc_slow_client_eviction()
+        logger.warning(
+            "evicting slow consumer: queue depth %d, %d video drops",
+            len(cq.q), cq.q.dropped_video_total)
+        cq.close()   # the drainer may be wedged inside a stalled send
+        ws = cq.ws
+
+        async def _kill():
+            try:
+                await asyncio.wait_for(ws.send("KILL slow_consumer"), 1.0)
+            except Exception:
+                pass
+            await ws.close()
+
+        self._spawn_background(_kill(), "evict-slow-client")
 
     def _viewers_of(self, display_id: str) -> Set[Any]:
         """Primary-display media is fanned out to every client (sharing
@@ -294,11 +426,15 @@ class DataStreamingServer:
 
         self._stop_event = asyncio.Event()
         bind_attempts = 0
+        # transport-level armor: an unbounded max_size lets one client
+        # frame buffer arbitrary memory before any handler runs
+        cap_mb = int(getattr(self.settings, "max_ws_message_mb", 0))
+        max_size = cap_mb * 1024 * 1024 if cap_mb > 0 else None
         while not self._stop_event.is_set():
             try:
                 async with ws_server.serve(
                     self.ws_handler, self.host, self.port,
-                    compression=None, max_size=None,
+                    compression=None, max_size=max_size,
                 ) as server:
                     self._server = server
                     bind_attempts = 0
@@ -317,6 +453,11 @@ class DataStreamingServer:
                 await asyncio.sleep(delay)
 
     async def stop(self) -> None:
+        if self._reconfig_task is not None and not self._reconfig_task.done():
+            self._reconfig_task.cancel()
+        for cq in list(self._send_queues.values()):
+            cq.close()
+        self._send_queues.clear()
         for st in list(self.display_clients.values()):
             await self._stop_display(st)
         for coord in self.mesh_coordinators.values():
@@ -333,7 +474,36 @@ class DataStreamingServer:
     # ------------------------------------------------------------------
     # connection handling
 
+    async def _admit(self, websocket) -> bool:
+        """Admission control at accept time (docs/hardening.md): a full or
+        load-shedding server rejects the connection gracefully — a wire
+        KILL the client UI can show — instead of degrading every session."""
+        maxc = int(getattr(self.settings, "max_clients", 0) or 0)
+        full = bool(maxc and len(self.clients) >= maxc)
+        if not full and not self._load_shedding:
+            return True
+        self.edge_stats["sessions_rejected"] += 1
+        if self.metrics is not None:
+            self.metrics.inc_sessions_rejected()
+        logger.warning("connection rejected: %s",
+                       "server_full" if full else "load_shedding")
+        try:
+            await websocket.send("KILL server_full")
+        except Exception:
+            pass
+        try:
+            await websocket.close()
+        except Exception:
+            pass
+        return False
+
     async def ws_handler(self, websocket) -> None:
+        if not await self._admit(websocket):
+            return
+        self._guards[websocket] = ConnectionGuard(
+            limits=self._limits,
+            error_budget=int(getattr(self.settings,
+                                     "protocol_error_budget", 25)))
         self.clients.add(websocket)
         if self.metrics is not None:
             self.metrics.set_clients(len(self.clients))
@@ -355,20 +525,70 @@ class DataStreamingServer:
                 await websocket.send(
                     "cursor," + json.dumps(self.app.last_cursor_sent))
             await websocket.send(json.dumps(self.settings.schema_payload()))
+            # handshake done: fan-out to this client now rides its bounded
+            # send queue (slow-consumer isolation + eviction)
+            self._send_queues[websocket] = _ClientSendQueue(
+                websocket,
+                BoundedSendQueue(
+                    max_video=int(self.settings.max_send_queue),
+                    evict_after_s=float(int(
+                        self.settings.slow_client_evict_s))),
+                on_evict=self._evict_slow_client)
             if self._stats_task is None or self._stats_task.done():
                 self._stats_task = asyncio.create_task(self._stats_loop())
             async for message in websocket:
-                if isinstance(message, (bytes, bytearray)):
-                    await self._handle_binary(websocket, message)
-                else:
-                    await self._handle_text(websocket, message)
+                # Per-message exception boundary: a malformed or
+                # handler-crashing message is dropped and charged against
+                # this connection's error budget — it must never end the
+                # async-for loop (= the whole session) the way a transport
+                # error does, and never touch other clients' sessions.
+                try:
+                    if isinstance(message, (bytes, bytearray)):
+                        await self._handle_binary(websocket, message)
+                    else:
+                        await self._handle_text(websocket, message)
+                except Exception as e:
+                    if (isinstance(e, ConnectionError)
+                            or type(e).__name__.startswith(
+                                "ConnectionClosed")):
+                        # a handler failing to SEND to a dead peer is
+                        # transport death, not client hostility: end the
+                        # session (pre-boundary behavior) instead of
+                        # polluting protocol_errors_total / the budget
+                        raise
+                    self.edge_stats["protocol_errors"] += 1
+                    if self.metrics is not None:
+                        self.metrics.inc_protocol_errors()
+                    logger.debug("protocol error (dropped message): %r", e)
+                    guard = self._guards.get(websocket)
+                    if guard is not None and guard.record_error():
+                        logger.warning(
+                            "error budget exhausted after %d protocol "
+                            "errors; killing abusive client",
+                            guard.errors_total)
+                        try:
+                            await websocket.send("KILL protocol_abuse")
+                        except Exception:
+                            pass
+                        await websocket.close()
+                        break
         except Exception as e:  # connection errors end the session
             logger.debug("ws session ended: %r", e)
         finally:
             self.clients.discard(websocket)
+            self._guards.pop(websocket, None)
+            cq = self._send_queues.pop(websocket, None)
+            if cq is not None:
+                cq.close()
             if self.metrics is not None:
                 self.metrics.set_clients(len(self.clients))
-            self._uploads.pop(websocket, None)
+            up = self._uploads.pop(websocket, None)
+            if up is not None:
+                # never leak the fd or the partial file of an interrupted
+                # upload (satellite: upload fd leak on disconnect)
+                self._abort_upload(up)
+                logger.info("upload aborted by disconnect: %s (%d/%d bytes)",
+                            up.path, up.received, up.size)
             dropped = False
             for st in list(self.display_clients.values()):
                 if st.ws is websocket:
@@ -377,7 +597,7 @@ class DataStreamingServer:
                     dropped = True
             if dropped and self.display_clients:
                 # surviving displays reflow into a smaller framebuffer
-                await self._reconfigure_displays()
+                self._schedule_reconfigure()
             if (not self.clients and self.audio_pipeline is not None
                     and self.audio_pipeline.running):
                 await self.audio_pipeline.stop()
@@ -385,15 +605,47 @@ class DataStreamingServer:
     # ------------------------------------------------------------------
     # text protocol
 
+    def _count_rate_limited(self, cls: str) -> None:
+        counts = self.edge_stats["rate_limited"]
+        counts[cls] = counts.get(cls, 0) + 1
+        if self.metrics is not None:
+            self.metrics.inc_rate_limited(cls)
+
+    def _count_upload_paced(self) -> None:
+        # pacing ACCEPTS the message after a sleep: a separate series so
+        # a fast healthy upload never reads as "dropped by rate limiting"
+        self.edge_stats["upload_paced"] += 1
+        if self.metrics is not None:
+            self.metrics.inc_upload_paced()
+
     async def _handle_text(self, websocket, message: str) -> None:
-        msg = parse_text_message(message)
+        msg = parse_text_message(message)   # ProtocolError → boundary
         verb = msg.verb
+
+        guard = self._guards.get(websocket)
+        if guard is not None:
+            cls = classify_verb(verb)
+            if cls == "upload":
+                # stateful upload verbs are paced like upload bytes, never
+                # dropped — a dropped FILE_UPLOAD_END leaves the fd open
+                # and splices the next file into it
+                wait = guard.throttle("upload", UPLOAD_VERB_COST)
+                if wait > 0:
+                    self._count_upload_paced()
+                    await asyncio.sleep(wait)
+            elif not guard.allow(cls):
+                self._count_rate_limited(cls)
+                logger.debug("rate-limited %s message %r", cls, verb[:32])
+                return
 
         if verb == "SETTINGS":
             await self._on_settings(websocket, msg.json_body or "{}")
         elif verb == "CLIENT_FRAME_ACK":
+            # Only the display's OWNER acks: a shared-mode viewer (or a
+            # hostile client) feeding random ids into the primary's
+            # backpressure state would wedge the gate for everyone.
             st = self._display_of(websocket)
-            if st and msg.args:
+            if st and st.ws is websocket and msg.args:
                 try:
                     st.bp.on_client_ack(int(msg.args[0]))
                 except ValueError:
@@ -402,16 +654,18 @@ class DataStreamingServer:
             await self._on_resize(websocket, msg.args)
         elif verb == "START_VIDEO":
             st = self._display_of(websocket)
-            if st:
+            if st and st.ws is websocket:
                 st.video_active = True
                 await self._start_display(st)
-                await websocket.send("VIDEO_STARTED")
+                # through the send queue, like PIPELINE_RESETTING: the
+                # reply must not overtake media already queued behind it
+                self._fanout({websocket}, "VIDEO_STARTED")
         elif verb == "STOP_VIDEO":
             st = self._display_of(websocket)
-            if st:
+            if st and st.ws is websocket:
                 st.video_active = False
                 await self._stop_display(st)
-                await websocket.send("VIDEO_STOPPED")
+                self._fanout({websocket}, "VIDEO_STOPPED")
         elif verb == "START_AUDIO":
             self._audio_wanted = True
             if self.audio_pipeline is not None:
@@ -428,12 +682,25 @@ class DataStreamingServer:
             up = self._uploads.pop(websocket, None)
             if up:
                 up.fobj.close()
-                logger.info("upload finished: %s (%d bytes)", up.path, up.received)
+                if up.size and up.received < up.size:
+                    # a short upload is a broken file: remove it and tell
+                    # the client rather than leaving truncated data behind
+                    logger.warning("short upload removed: %s (%d/%d bytes)",
+                                   up.path, up.received, up.size)
+                    try:
+                        os.unlink(up.path)
+                    except OSError:
+                        pass
+                    await websocket.send(
+                        f"FILE_UPLOAD_ERROR:{up.rel_path}:"
+                        f"short upload ({up.received}/{up.size} bytes)")
+                else:
+                    logger.info("upload finished: %s (%d bytes)",
+                                up.path, up.received)
         elif verb == "FILE_UPLOAD_ERROR":
             up = self._uploads.pop(websocket, None)
             if up:
-                up.fobj.close()
-                os.unlink(up.path)
+                self._abort_upload(up)
         elif verb == "s" and msg.args:
             # scale request (reference "s,<scale>"): HiDPI factor → Xft DPI
             try:
@@ -458,7 +725,7 @@ class DataStreamingServer:
             # like the reference ws_handler does for non-prefixed text.
             if verb == "_f":
                 st = self._display_of(websocket)
-                if st and msg.args:
+                if st and st.ws is websocket and msg.args:
                     try:
                         fps = float(msg.args[0])
                         st.bp.on_client_fps(fps)
@@ -482,9 +749,21 @@ class DataStreamingServer:
 
     async def _handle_binary(self, websocket, data: bytes) -> None:
         if not data:
-            return
+            raise ProtocolError("empty binary frame")
+        guard = self._guards.get(websocket)
         t = data[0]
         if t == 0x01:  # file chunk
+            if guard is not None:
+                # uploads are PACED, not dropped (a dropped chunk corrupts
+                # the file): sleeping here stops reading the socket, which
+                # backpressures the sender through TCP. Charged BEFORE the
+                # open-upload check so orphan 0x01 floods (no
+                # FILE_UPLOAD_START) are metered like any other bytes
+                # instead of being a free unmetered lane.
+                wait = guard.throttle("upload", len(data))
+                if wait > 0:
+                    self._count_upload_paced()
+                    await asyncio.sleep(wait)
             up = self._uploads.get(websocket)
             if up:
                 # Absolute cap holds even when the client declares size 0
@@ -494,8 +773,7 @@ class DataStreamingServer:
                 limit = min(up.size, cap) if up.size else cap
                 if limit and up.received + len(data) - 1 > limit:
                     self._uploads.pop(websocket, None)
-                    up.fobj.close()
-                    os.unlink(up.path)
+                    self._abort_upload(up)
                     await websocket.send(
                         f"FILE_UPLOAD_ERROR:{up.rel_path}:"
                         "exceeded size limit")
@@ -503,8 +781,35 @@ class DataStreamingServer:
                 up.fobj.write(data[1:])
                 up.received += len(data) - 1
         elif t == 0x02:  # microphone PCM
+            cap = int(getattr(self.settings, "max_mic_chunk_kb", 0)) * 1024
+            if cap and len(data) - 1 > cap:
+                # file chunks have max_upload_mb; mic bytes get their own
+                # cap before they reach the audio pipeline's resampler
+                raise ProtocolError(
+                    f"mic chunk of {len(data) - 1} bytes exceeds "
+                    f"{cap}-byte cap")
+            if guard is not None and not guard.allow("mic", len(data)):
+                self._count_rate_limited("mic")
+                return
             if self.audio_pipeline is not None:
                 await self.audio_pipeline.on_mic_data(data[1:])
+        else:
+            # the canonical demux raises the precise rejection (wrong-
+            # direction 0x00/0x03/0x04 vs unknown) — one trust boundary,
+            # not two that drift
+            unpack_client_binary(data)
+            raise ProtocolError(f"unroutable client binary type 0x{t:02x}")
+
+    def _abort_upload(self, up: _Upload) -> None:
+        """Close the fd and remove the partial file of a dead upload."""
+        try:
+            up.fobj.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(up.path)
+        except OSError:
+            pass
 
     # ------------------------------------------------------------------
     # settings negotiation
@@ -522,6 +827,28 @@ class DataStreamingServer:
             await websocket.close()
             return
 
+        # Parse/clamp every client value BEFORE touching any state: a
+        # garbage value must cost only itself (ignored + logged), never
+        # leave a half-registered zombie display holding a max_displays
+        # slot or a live display with partially-applied settings.
+        known = {s.name for s in SETTING_DEFINITIONS}
+        applied: Dict[str, Any] = {}
+        width = height = None
+        for key, value in requested.items():
+            if key in ("displayId",):
+                continue
+            try:
+                if key == "initialClientWidth":
+                    width = _clamp_dim(value)
+                elif key == "initialClientHeight":
+                    height = _clamp_dim(value)
+                elif key in known:
+                    applied[key] = self.settings.clamp_client_value(
+                        key, value)
+            except (TypeError, ValueError):
+                logger.warning("ignoring bad client setting %s=%r",
+                               key, value)
+
         st = self.display_clients.get(display_id)
         if st and st.ws is not None and st.ws is not websocket:
             # superseded client for this display: kill the old one
@@ -531,23 +858,25 @@ class DataStreamingServer:
             except Exception:
                 pass
         if st is None:
+            maxd = int(getattr(self.settings, "max_displays", 0) or 0)
+            if maxd and len(self.display_clients) >= maxd:
+                # admission control on the display plane: each display is
+                # a capture+encode pipeline, far heavier than a viewer
+                self.edge_stats["sessions_rejected"] += 1
+                if self.metrics is not None:
+                    self.metrics.inc_sessions_rejected()
+                logger.warning("display %s rejected: %d displays at cap",
+                               display_id, len(self.display_clients))
+                await websocket.send("KILL server_full")
+                await websocket.close()
+                return
             st = DisplayState(display_id=display_id)
             self.display_clients[display_id] = st
         st.ws = websocket
-
-        known = {s.name for s in SETTING_DEFINITIONS}
-        applied: Dict[str, Any] = {}
-        for key, value in requested.items():
-            if key in ("displayId",):
-                continue
-            if key == "initialClientWidth":
-                st.width = max(16, int(value) & ~1)
-                continue
-            if key == "initialClientHeight":
-                st.height = max(16, int(value) & ~1)
-                continue
-            if key in known:
-                applied[key] = self.settings.clamp_client_value(key, value)
+        if width is not None:
+            st.width = width
+        if height is not None:
+            st.height = height
         st.overrides.update(applied)
         if "framerate" in applied:
             st.bp.framerate = float(applied["framerate"])
@@ -555,7 +884,7 @@ class DataStreamingServer:
 
         if "scaling_dpi" in applied:
             await self._apply_dpi(int(applied["scaling_dpi"]))
-        await self._reconfigure_displays()
+        self._schedule_reconfigure()
 
     async def _apply_dpi(self, dpi: int) -> None:
         from ..display import DpiManager
@@ -575,15 +904,51 @@ class DataStreamingServer:
         except (ValueError, IndexError):
             return
         st = self.display_clients.get(display_id)
-        if not st:
+        if not st or st.ws is not websocket:
+            # resizing is owner-only: a viewer must not be able to force
+            # stop-the-world reconfigurations of someone else's display
             return
-        st.width, st.height = max(16, w & ~1), max(16, h & ~1)
-        await self._reconfigure_displays()
+        st.width, st.height = _clamp_dim(w), _clamp_dim(h)
+        self._schedule_reconfigure()
         self.broadcast(json.dumps({
             "type": "stream_resolution",
             "width": st.width,
             "height": st.height,
         }))
+
+    def _schedule_reconfigure(self) -> None:
+        """Debounce/coalesce display reconfiguration behind one serialized
+        worker task: ``_reconfigure_displays`` stops and restarts EVERY
+        capture pipeline, so a client spamming ``r,<WxH>`` must cost one
+        reconfiguration per storm, not one per message."""
+        self._reconfig_dirty = True
+        if self._reconfig_task is None or self._reconfig_task.done():
+            self._reconfig_task = asyncio.create_task(
+                self._reconfigure_worker())
+        else:
+            self.edge_stats["reconfigure_coalesced"] += 1
+            if self.metrics is not None:
+                self.metrics.inc_reconfigure_coalesced()
+
+    async def _reconfigure_worker(self) -> None:
+        try:
+            debounce = max(0, int(getattr(self.settings,
+                                          "resize_debounce_ms", 0))) / 1000.0
+            while self._reconfig_dirty:
+                if debounce:
+                    # absorb the rest of the storm before doing the work;
+                    # requests landing mid-run re-arm the dirty flag and
+                    # get one more (batched) pass
+                    await asyncio.sleep(debounce)
+                self._reconfig_dirty = False
+                self.edge_stats["reconfigure_runs"] += 1
+                await self._reconfigure_displays()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # a failed reconfigure must not take the worker down with an
+            # unretrieved exception; the next request starts a fresh one
+            logger.exception("display reconfiguration failed")
 
     async def _reconfigure_displays(self) -> None:
         """Full display-plane reconfiguration (reference reconfigure_displays
@@ -646,7 +1011,9 @@ class DataStreamingServer:
             self.broadcast(message)
         elif st.ws:
             try:
-                await st.ws.send(message)
+                # ride the same per-client queue as the media so the reset
+                # keeps its FIFO position relative to queued frames
+                self._fanout({st.ws}, message)
             except Exception:
                 # a dead secondary socket must not crash the (supervised)
                 # restart that is trying to recover its display
@@ -862,7 +1229,7 @@ class DataStreamingServer:
                     for s in stripes:
                         chunk = self._pack_stripe(frame_id, s, encoder)
                         if viewers:
-                            _ws_broadcast(viewers, chunk)
+                            self._fanout(viewers, chunk)
                             self.bytes_sent += len(chunk) * len(viewers)
                     st.bp.on_frame_sent(frame_id)
                 if any(stripes for _seq, stripes in harvested):
@@ -1122,6 +1489,45 @@ class DataStreamingServer:
         self.metrics.set_degradation_rung(max(levels) if levels else 0)
         self.metrics.set_failed_displays(self._failed_displays())
 
+    def _update_load_shed(self) -> None:
+        """Admission-control load shedding (stats-tick cadence): when the
+        encode pipelines report sustained frame drops — the device can no
+        longer keep up with the admitted load — stop admitting NEW
+        connections until the drop rate recovers. Existing sessions keep
+        their backpressure/degradation machinery; shedding only protects
+        them from additional load."""
+        threshold = int(getattr(self.settings, "shed_drop_threshold", 0) or 0)
+        if threshold <= 0:
+            self._load_shedding = False
+            return
+        total = 0
+        for st in self.display_clients.values():
+            enc = st.encoder
+            if enc is not None and hasattr(enc, "stats"):
+                try:
+                    total += int(enc.stats().get("frames_dropped", 0))
+                except Exception:
+                    pass
+        delta = total - self._last_dropped_total
+        if delta < 0:
+            # a supervised restart replaced an encoder (its cumulative
+            # counter restarted from zero) — exactly when overload churn
+            # is likely; the new encoder's drops are all new drops, so
+            # count the post-reset total rather than resetting the strikes
+            delta = total
+        self._last_dropped_total = total
+        if delta >= threshold:
+            self._shed_strikes += 1
+        else:
+            self._shed_strikes = 0
+        shedding = self._shed_strikes >= 2
+        if shedding != self._load_shedding:
+            logger.warning(
+                "load shedding %s (%d frames dropped this tick, "
+                "threshold %d)",
+                "engaged" if shedding else "released", delta, threshold)
+        self._load_shedding = shedding
+
     def _broadcast_health(self) -> None:
         try:
             self._publish_health_metrics()
@@ -1172,7 +1578,9 @@ class DataStreamingServer:
         os.makedirs(os.path.dirname(target), exist_ok=True)
         old = self._uploads.pop(websocket, None)
         if old:
-            old.fobj.close()
+            # superseded mid-flight: remove the truncated partial too, or
+            # the /files listing serves it as if complete
+            self._abort_upload(old)
         self._uploads[websocket] = _Upload(
             path=target, rel_path=rel_path, fobj=open(target, "wb"), size=size)
         logger.info("upload started: %s (%d bytes)", target, size)
@@ -1199,11 +1607,15 @@ class DataStreamingServer:
         while True:
             await asyncio.sleep(STATS_INTERVAL_S)
             try:
+                self._update_load_shed()
                 if self.metrics is not None:
                     # aggregated ONCE per tick here, not per display loop
                     self.metrics.set_backpressured(sum(
                         1 for d in self.display_clients.values()
                         if not d.bp.send_enabled))
+                    self.metrics.set_send_queue_depth(max(
+                        (len(cq.q) for cq in self._send_queues.values()),
+                        default=0))
                     self._publish_health_metrics()
                 stats = self._collect_system_stats()
                 self.broadcast(json.dumps(stats))
@@ -1233,6 +1645,20 @@ class DataStreamingServer:
                     net["mesh_worker_restarts"] = sum(
                         coord.worker_restarts_total
                         for coord in self.mesh_coordinators.values())
+                edge = self.edge_stats
+                if (edge["protocol_errors"] or edge["rate_limited"]
+                        or edge["sessions_rejected"]
+                        or edge["slow_client_evictions"]):
+                    # hostile-client activity rides the stats feed so a
+                    # dashboardless operator still sees it
+                    net["edge"] = {
+                        "protocol_errors": edge["protocol_errors"],
+                        "rate_limited": dict(edge["rate_limited"]),
+                        "sessions_rejected": edge["sessions_rejected"],
+                        "slow_client_evictions":
+                            edge["slow_client_evictions"],
+                        "load_shedding": self._load_shedding,
+                    }
                 prev_bytes = self.bytes_sent
                 self.broadcast(json.dumps(net))
                 if self.display_clients:

@@ -342,6 +342,42 @@ SETTING_DEFINITIONS: List[Spec] = [
             "rung before the ladder probes back up one rung.",
             server_only=True),
 
+    # --- Edge hardening / admission control (server-only; docs/hardening.md)
+    IntSpec("max_clients", 32, "Maximum concurrent websocket clients; the "
+            "next connection is rejected with KILL server_full "
+            "(0 = unlimited).", server_only=True),
+    IntSpec("max_displays", 4, "Maximum concurrent display pipelines; a "
+            "SETTINGS handshake for a further display is rejected with "
+            "KILL server_full (0 = unlimited).", server_only=True),
+    IntSpec("protocol_error_budget", 25, "Per-connection protocol-error "
+            "budget (token bucket, slow refill); exhausting it sends "
+            "KILL protocol_abuse and closes that socket.", server_only=True),
+    StrSpec("rate_limits", "", "Per-class rate-limit overrides, grammar "
+            "class=rate[:burst],... over classes input/control/settings/"
+            "resize/upload/mic (empty = built-in defaults; see "
+            "docs/hardening.md).", server_only=True),
+    IntSpec("resize_debounce_ms", 200, "Debounce window for display "
+            "reconfiguration: resize/SETTINGS churn inside the window "
+            "coalesces into one stop-the-world reconfigure.",
+            server_only=True),
+    IntSpec("max_send_queue", 240, "Per-client bounded send-queue depth for "
+            "media messages (drop-oldest-video; control is never dropped).",
+            server_only=True),
+    IntSpec("slow_client_evict_s", 4, "Seconds of sustained send-queue "
+            "overflow before a slow consumer is evicted with "
+            "KILL slow_consumer.", server_only=True),
+    IntSpec("max_mic_chunk_kb", 256, "Largest accepted microphone PCM chunk "
+            "in KiB; oversize chunks are dropped before reaching the audio "
+            "pipeline.", server_only=True),
+    IntSpec("max_ws_message_mb", 32, "Largest accepted websocket message in "
+            "MiB (transport-level cap; 0 = unlimited, reference behavior).",
+            server_only=True),
+    IntSpec("shed_drop_threshold", 0, "Load shedding: encoder frames "
+            "dropped per stats tick that count as sustained overload; two "
+            "consecutive overloaded ticks reject NEW connections with "
+            "KILL server_full until the drop rate recovers (0 = disabled).",
+            server_only=True),
+
     # --- TPU-native additions (server-only) ---
     IntSpec("tpu_stripe_height", 64, "Encoder stripe height in rows (multiple of 16).",
             server_only=True),

@@ -1,0 +1,766 @@
+"""Wire-edge hardening tests (ISSUE 3): protocol armor, admission
+control, rate limiting, resize coalescing, and slow-consumer eviction.
+
+Like tests/test_robustness.py, everything drives the real
+``DataStreamingServer.ws_handler`` through in-process fake websockets
+(``robustness.testing.InProcessClient``) — no network, no ``websockets``
+package. Acceptance criteria covered here:
+
+(a) a deterministic 500-message fuzz corpus through ``ws_handler`` kills
+    zero sessions and leaves ``_uploads`` empty;
+(b) a 50-message resize storm triggers ≤ 3 reconfigurations while a
+    concurrent healthy client keeps receiving frames;
+(c) a stalled consumer is evicted (``slow_client_evictions_total``)
+    while a second client's frame IDs keep advancing;
+(d) the (max_clients+1)-th connection is rejected with
+    ``KILL server_full`` and ``sessions_rejected_total`` incremented.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from selkies_tpu.encoder.jpeg import StripeOutput
+from selkies_tpu.observability.metrics import HAVE_PROM, Metrics
+from selkies_tpu.protocol import VideoStripe, unpack_binary
+from selkies_tpu.robustness import (BoundedSendQueue, ConnectionGuard,
+                                    InProcessClient, TokenBucket,
+                                    classify_verb, parse_limit_spec)
+from selkies_tpu.server.app import StreamingApp
+from selkies_tpu.server.data_server import DataStreamingServer
+from selkies_tpu.settings import Settings
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+# ---------------------------------------------------------------------------
+# fakes (same shapes as test_robustness.py)
+
+
+class FakeEncoder:
+    def __init__(self, overrides=None):
+        self.submitted = 0
+        self.closed = False
+        self._ready = []
+
+    def submit(self, frame):
+        self.submitted += 1
+        self._ready.append(
+            (self.submitted,
+             [StripeOutput(y_start=0, height=64,
+                           jpeg=b"\xff\xd8FAKE%d" % self.submitted
+                           + b"\xff\xd9",
+                           is_paintover=False)]))
+
+    def poll(self):
+        out, self._ready = self._ready, []
+        return out
+
+    def flush(self):
+        return self.poll()
+
+    def close(self):
+        self.closed = True
+
+
+class FakeSource:
+    def __init__(self, width, height, fps):
+        self.width, self.height, self.fps = width, height, fps
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def next_frame(self):
+        return np.zeros((self.height, self.width, 3), np.uint8)
+
+
+class StalledClient(InProcessClient):
+    """A consumer whose reads stall after the handshake: ``send`` blocks
+    forever once ``stall`` is set, like a TCP peer that stopped ACKing."""
+
+    def __init__(self):
+        super().__init__()
+        self.stall = False
+        self._stalled = asyncio.Event()
+
+    # send_nowait stays (pre-queue handshake broadcasts); the bounded
+    # send queue's drainer always awaits async send, where the stall bites
+
+    async def send(self, message):
+        if self.stall:
+            self._stalled.set()
+            await asyncio.Event().wait()    # never set: blocks forever
+        await super().send(message)
+
+
+def make_server(**settings_env):
+    env = {"SELKIES_PORT": "0", "SELKIES_AUDIO_ENABLED": "false",
+           "SELKIES_COMMAND_ENABLED": "false"}
+    env.update(settings_env)
+    settings = Settings(argv=[], env=env)
+    app = StreamingApp(settings)
+
+    server = DataStreamingServer(
+        settings, app=app,
+        encoder_factory=lambda w, h, s, overrides=None: FakeEncoder(),
+        source_factory=lambda w, h, fps, **kw: FakeSource(w, h, fps),
+        host="127.0.0.1",
+    )
+    app.data_server = server
+    return server
+
+
+async def wait_until(pred, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+async def open_client(server, settings_body=None, ws=None):
+    ws = ws or InProcessClient()
+    task = asyncio.create_task(server.ws_handler(ws))
+    assert await wait_until(
+        lambda: len(ws.sent) >= 2 or task.done(), timeout=5.0)
+    if settings_body is not None:
+        ws.feed("SETTINGS," + json.dumps(settings_body))
+    return ws, task
+
+
+async def close_client(ws, task):
+    await ws.close()
+    try:
+        await asyncio.wait_for(task, 5.0)
+    except asyncio.TimeoutError:
+        task.cancel()
+
+
+PRIMARY = {"displayId": "primary", "initialClientWidth": 320,
+           "initialClientHeight": 240, "framerate": 60}
+
+
+# ---------------------------------------------------------------------------
+# ratelimit primitives (pure, clock-injected)
+
+
+def test_token_bucket_refill_and_burst():
+    now = [0.0]
+    b = TokenBucket(rate=10.0, burst=5.0, clock=lambda: now[0])
+    assert all(b.try_take() for _ in range(5))
+    assert not b.try_take()            # burst exhausted
+    now[0] = 0.3                       # +3 tokens
+    assert b.try_take() and b.try_take() and b.try_take()
+    assert not b.try_take()
+    now[0] = 100.0
+    assert b.tokens == 5.0             # capped at burst
+
+
+def test_parse_limit_spec_overrides_and_rejects():
+    limits = parse_limit_spec("settings=2:10,mic=512000")
+    assert limits["settings"] == (2.0, 10.0)
+    assert limits["mic"] == (512000.0, 1024000.0)   # burst defaults to 2x
+    assert limits["input"][0] > 0                   # defaults kept
+    with pytest.raises(ValueError):
+        parse_limit_spec("nosuchclass=5")
+    with pytest.raises(ValueError):
+        parse_limit_spec("settings=-1")
+    with pytest.raises(ValueError):
+        parse_limit_spec("garbage")
+
+
+def test_classify_verb_table():
+    assert classify_verb("SETTINGS") == "settings"
+    assert classify_verb("cmd") == "settings"
+    # pipeline-toggling verbs are as heavy as SETTINGS (stop/start a
+    # capture pipeline or the shared audio pipeline), so they share the
+    # human-scale bucket — not the 300/s control bucket
+    for v in ("START_VIDEO", "STOP_VIDEO", "START_AUDIO", "STOP_AUDIO"):
+        assert classify_verb(v) == "settings"
+    assert classify_verb("r") == "resize"
+    assert classify_verb("s") == "resize"
+    assert classify_verb("CLIENT_FRAME_ACK") == "control"
+    # stateful upload verbs ride the upload (paced, never dropped) lane:
+    # a dropped FILE_UPLOAD_END would corrupt the transfer
+    for v in ("FILE_UPLOAD_START", "FILE_UPLOAD_END", "FILE_UPLOAD_ERROR"):
+        assert classify_verb(v) == "upload"
+    for v in ("kd", "m", "m2", "js", "cw", "pong", "whatever"):
+        assert classify_verb(v) == "input"
+
+
+def test_allow_clamps_units_to_burst():
+    # a unit larger than the burst must still be admissible at a bounded
+    # rate (size gating is the caps' job, the bucket meters rate)
+    now = [0.0]
+    g = ConnectionGuard(limits={"mic": (100.0, 50.0)}, clock=lambda: now[0])
+    assert g.allow("mic", 500)         # burst-sized charge, admitted
+    assert not g.allow("mic", 500)     # bucket drained: limited now
+    now[0] = 0.5                       # refill at the configured rate
+    assert g.allow("mic", 500)
+
+
+def test_upload_bytes_are_paced_not_dropped():
+    now = [0.0]
+    b = TokenBucket(rate=100.0, burst=50.0, clock=lambda: now[0])
+    assert b.take_with_debt(50) == 0.0
+    assert b.take_with_debt(100) == pytest.approx(1.0)   # 100 in debt
+    now[0] = 2.0                           # debt repaid, burst restored
+    assert b.take_with_debt(1) == 0.0
+    g = ConnectionGuard(limits={"upload": (100.0, 50.0)},
+                        clock=lambda: now[0])
+    assert g.throttle("upload", 10) == 0.0
+    assert g.throttle("upload", 1000) > 0.0               # paced, accepted
+    assert g.throttle("upload", 10 ** 9) <= 30.0          # wait is capped
+
+
+def test_connection_guard_error_budget_refills():
+    now = [0.0]
+    g = ConnectionGuard(error_budget=3, error_refill_per_s=1.0,
+                        clock=lambda: now[0])
+    assert not g.record_error()
+    assert not g.record_error()
+    assert not g.record_error()
+    assert g.record_error()            # budget exhausted
+    now[0] = 2.0                       # slow refill forgives old sins
+    assert not g.record_error()
+    assert g.errors_total == 5
+
+
+def test_bounded_send_queue_drop_oldest_video_never_control():
+    now = [0.0]
+    q = BoundedSendQueue(max_video=3, evict_after_s=1.0,
+                         clock=lambda: now[0])
+    q.offer("control-1", control=True)
+    for i in range(3):
+        q.offer(b"v%d" % i)
+    assert q.offer(b"v3") is False     # drops v0, keeps control
+    assert q.dropped_video_total == 1
+    assert q.overflow_since == 0.0
+    got = [q.pop() for _ in range(4)]
+    assert got == ["control-1", b"v1", b"v2", b"v3"]
+    assert q.pop() is None
+    assert q.overflow_since is None    # drained below half: caught up
+    # sustained overflow → eviction verdict
+    for i in range(10):
+        q.offer(b"x%d" % i)
+    assert not q.should_evict
+    now[0] = 2.0
+    q.offer(b"y")
+    assert q.should_evict
+
+
+# ---------------------------------------------------------------------------
+# acceptance (a): deterministic fuzz corpus — zero session deaths
+
+
+@pytest.mark.anyio
+async def test_fuzz_corpus_kills_no_sessions(tmp_path, monkeypatch):
+    from tools.proto_fuzz import fuzz_session
+
+    monkeypatch.setenv("SELKIES_UPLOAD_DIR", str(tmp_path / "up"))
+    report = await fuzz_session(iterations=500, seed=0)
+    assert report["premature_deaths"] == 0, report
+    assert report["kills"] == 0, report
+    assert report["uploads_leaked"] == 0, report
+    assert report["observer_alive"], report
+    assert report["observer_streaming"], report
+    # the corpus actually exercised the boundary
+    assert report["protocol_errors"] > 0, report
+
+
+# ---------------------------------------------------------------------------
+# acceptance (b): resize storm coalesces; healthy client keeps streaming
+
+
+@pytest.mark.anyio
+async def test_resize_storm_coalesces_reconfigures(monkeypatch):
+    server = make_server(SELKIES_RESIZE_DEBOUNCE_MS="150")
+    runs_before = None
+    ws, task = await open_client(server, PRIMARY)
+    viewer, viewer_task = await open_client(server)   # healthy co-viewer
+    try:
+        assert await wait_until(lambda: viewer.n_frames() >= 2)
+        runs_before = server.edge_stats["reconfigure_runs"]
+        n0 = viewer.n_frames()
+        for i in range(50):
+            ws.feed(f"r,{320 + 2 * (i % 7)}x{240 + 2 * (i % 5)},primary")
+        # let the handler ingest the whole storm, then the debounced
+        # worker settle
+        assert await wait_until(lambda: ws._incoming.empty(), timeout=10.0)
+        assert await wait_until(
+            lambda: not server._reconfig_dirty
+            and (server._reconfig_task is None
+                 or server._reconfig_task.done()),
+            timeout=10.0)
+        runs = server.edge_stats["reconfigure_runs"] - runs_before
+        assert 1 <= runs <= 3, f"storm cost {runs} reconfigurations"
+        # most of the storm was absorbed: coalesced or rate-limited
+        absorbed = (server.edge_stats["reconfigure_coalesced"]
+                    + server.edge_stats["rate_limited"].get("resize", 0))
+        assert absorbed >= 40, server.edge_stats
+        # the healthy viewer kept receiving frames through the storm
+        assert await wait_until(lambda: viewer.n_frames() > n0 + 2)
+        assert not viewer.closed
+    finally:
+        await close_client(viewer, viewer_task)
+        await close_client(ws, task)
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance (c): slow-consumer eviction; healthy client unaffected
+
+
+@pytest.mark.anyio
+async def test_stalled_consumer_evicted_healthy_keeps_streaming():
+    server = make_server(
+        SELKIES_MAX_SEND_QUEUE="8",
+        SELKIES_SLOW_CLIENT_EVICT_S="0",   # evict on first sustained drop
+    )
+    if HAVE_PROM:
+        server.metrics = Metrics(port=0)
+    owner, owner_task = await open_client(server, PRIMARY)
+    slow = StalledClient()
+    slow, slow_task = await open_client(server, ws=slow)
+    try:
+        assert await wait_until(lambda: owner.n_frames() >= 2)
+        assert await wait_until(lambda: slow.n_frames() >= 1)
+        slow.stall = True                  # the viewer stops reading
+        assert await wait_until(
+            lambda: server.edge_stats["slow_client_evictions"] >= 1,
+            timeout=15.0)
+        assert await wait_until(lambda: slow.closed, timeout=10.0)
+        # the owner's frame ids kept advancing past the eviction
+        ids = [unpack_binary(m).frame_id for m in owner.binary()[-2:]]
+        assert await wait_until(lambda: owner.binary() and isinstance(
+            unpack_binary(owner.binary()[-1]), VideoStripe)
+            and unpack_binary(owner.binary()[-1]).frame_id > max(ids))
+        assert not owner.closed
+        if HAVE_PROM:
+            text = server.metrics.render().decode()
+            assert "slow_client_evictions_total 1.0" in text
+    finally:
+        await close_client(slow, slow_task)
+        await close_client(owner, owner_task)
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance (d): admission control
+
+
+@pytest.mark.anyio
+async def test_max_clients_rejects_with_kill_server_full():
+    server = make_server(SELKIES_MAX_CLIENTS="2")
+    if HAVE_PROM:
+        server.metrics = Metrics(port=0)
+    ws1, t1 = await open_client(server, PRIMARY)
+    ws2, t2 = await open_client(server)
+    try:
+        assert len(server.clients) == 2
+        ws3 = InProcessClient()
+        t3 = asyncio.create_task(server.ws_handler(ws3))
+        await asyncio.wait_for(t3, 5.0)          # rejected → handler returns
+        assert ws3.sent == ["KILL server_full"]
+        assert ws3.closed
+        assert server.edge_stats["sessions_rejected"] == 1
+        assert len(server.clients) == 2          # never admitted
+        # the admitted clients are untouched
+        assert await wait_until(lambda: ws2.n_frames() >= 1)
+        if HAVE_PROM:
+            assert "sessions_rejected_total 1.0" in \
+                server.metrics.render().decode()
+        # a slot freeing up re-opens admission
+        await close_client(ws2, t2)
+        ws4, t4 = await open_client(server)
+        assert not ws4.closed
+        await close_client(ws4, t4)
+    finally:
+        await close_client(ws1, t1)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_max_displays_rejects_further_pipelines():
+    server = make_server(SELKIES_MAX_DISPLAYS="1")
+    ws1, t1 = await open_client(server, PRIMARY)
+    ws2, t2 = await open_client(server)
+    try:
+        assert await wait_until(lambda: "primary" in server.display_clients)
+        ws2.feed("SETTINGS," + json.dumps({"displayId": "display2"}))
+        assert await wait_until(
+            lambda: any(isinstance(m, str) and m == "KILL server_full"
+                        for m in ws2.sent))
+        assert await wait_until(lambda: ws2.closed)
+        assert server.edge_stats["sessions_rejected"] == 1
+        assert "display2" not in server.display_clients
+        assert not ws1.closed
+    finally:
+        await close_client(ws2, t2)
+        await close_client(ws1, t1)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_load_shedding_rejects_new_connections():
+    server = make_server(SELKIES_SHED_DROP_THRESHOLD="10")
+    ws1, t1 = await open_client(server, PRIMARY)
+    try:
+        assert await wait_until(lambda: "primary" in server.display_clients)
+        st = server.display_clients["primary"]
+
+        class DroppyEncoder(FakeEncoder):
+            dropped = 0
+
+            def stats(self):
+                return {"frames_dropped": self.dropped}
+
+        assert await wait_until(lambda: st.encoder is not None)
+        enc = DroppyEncoder()
+        st.encoder = enc
+        # two consecutive over-threshold ticks engage shedding
+        enc.dropped = 20
+        server._update_load_shed()
+        enc.dropped = 40
+        server._update_load_shed()
+        assert server._load_shedding
+        ws2 = InProcessClient()
+        t2 = asyncio.create_task(server.ws_handler(ws2))
+        await asyncio.wait_for(t2, 5.0)
+        assert ws2.sent == ["KILL server_full"]
+        assert server.edge_stats["sessions_rejected"] == 1
+        # a supervised restart resets the encoder's cumulative counter;
+        # the post-reset total still counts as new drops (no spurious
+        # strike reset mid-overload)
+        enc.dropped = 15
+        server._update_load_shed()
+        assert server._load_shedding
+        # recovery: drops stop → shedding releases → admission resumes
+        enc.dropped = 15               # unchanged: delta 0 this tick
+        server._update_load_shed()
+        assert not server._load_shedding
+        ws3, t3 = await open_client(server)
+        assert not ws3.closed
+        await close_client(ws3, t3)
+    finally:
+        await close_client(ws1, t1)
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: per-message boundary + error budget
+
+
+@pytest.mark.anyio
+async def test_malformed_messages_never_kill_session():
+    server = make_server()
+    ws, task = await open_client(server, PRIMARY)
+    try:
+        assert await wait_until(lambda: ws.n_frames() >= 1)
+        for bad in ("KILL you", "PIPELINE_RESETTING primary",
+                    b"\x7fgarbage", b"", b"\x00\x01\x00\x02fullframe",
+                    "SETTINGS,[]"):
+            ws.feed(bad)
+        n_err = 6
+        assert await wait_until(
+            lambda: server.edge_stats["protocol_errors"] >= n_err)
+        n0 = ws.n_frames()
+        assert await wait_until(lambda: ws.n_frames() > n0 + 2)
+        assert not ws.closed and not task.done()
+    finally:
+        await close_client(ws, task)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_error_budget_exhaustion_kills_only_abuser():
+    server = make_server(SELKIES_PROTOCOL_ERROR_BUDGET="5")
+    if HAVE_PROM:
+        server.metrics = Metrics(port=0)
+    owner, owner_task = await open_client(server, PRIMARY)
+    abuser, abuser_task = await open_client(server)
+    try:
+        assert await wait_until(lambda: owner.n_frames() >= 1)
+        for _ in range(10):
+            abuser.feed(b"\xee hostile binary")
+        assert await wait_until(
+            lambda: any(m == "KILL protocol_abuse"
+                        for m in abuser.texts()), timeout=10.0)
+        await asyncio.wait_for(abuser_task, 5.0)
+        assert abuser.closed
+        # one socket died; the session loop of others is untouched
+        n0 = owner.n_frames()
+        assert await wait_until(lambda: owner.n_frames() > n0 + 2)
+        assert not owner.closed
+        if HAVE_PROM:
+            assert "protocol_errors_total" in \
+                server.metrics.render().decode()
+    finally:
+        await close_client(abuser, abuser_task)
+        await close_client(owner, owner_task)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_input_flood_is_rate_limited_not_fatal():
+    server = make_server(SELKIES_RATE_LIMITS="input=50:100")
+    if HAVE_PROM:
+        server.metrics = Metrics(port=0)
+    ws, task = await open_client(server, PRIMARY)
+    try:
+        assert await wait_until(lambda: ws.n_frames() >= 1)
+        for i in range(500):
+            ws.feed(f"m,{i},{i},0,0")
+        assert await wait_until(
+            lambda: server.edge_stats["rate_limited"].get("input", 0) >= 300)
+        assert not ws.closed
+        n0 = ws.n_frames()
+        assert await wait_until(lambda: ws.n_frames() > n0)
+        if HAVE_PROM:
+            text = server.metrics.render().decode()
+            assert 'rate_limited_total{klass="input"}' in text
+    finally:
+        await close_client(ws, task)
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellites: upload fd hygiene, mic cap, viewer ownership
+
+
+@pytest.mark.anyio
+async def test_upload_cleanup_on_disconnect(tmp_path, monkeypatch):
+    monkeypatch.setenv("SELKIES_UPLOAD_DIR", str(tmp_path))
+    server = make_server()
+    ws, task = await open_client(server, PRIMARY)
+    try:
+        ws.feed("FILE_UPLOAD_START:partial.bin:1000")
+        ws.feed(b"\x01" + b"x" * 100)
+        assert await wait_until(lambda: ws in server._uploads)
+        up = server._uploads[ws]
+        # disconnect mid-upload: fd closed, partial file unlinked
+        await close_client(ws, task)
+        assert server._uploads == {}
+        assert up.fobj.closed
+        assert not os.path.exists(up.path)
+    finally:
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_short_upload_detected_and_unlinked(tmp_path, monkeypatch):
+    monkeypatch.setenv("SELKIES_UPLOAD_DIR", str(tmp_path))
+    server = make_server()
+    ws, task = await open_client(server, PRIMARY)
+    try:
+        ws.feed("FILE_UPLOAD_START:short.bin:1000")
+        ws.feed(b"\x01" + b"x" * 10)
+        ws.feed("FILE_UPLOAD_END:short.bin")
+        assert await wait_until(
+            lambda: any(isinstance(m, str)
+                        and m.startswith("FILE_UPLOAD_ERROR:short.bin")
+                        for m in ws.sent))
+        assert not (tmp_path / "short.bin").exists()
+        assert server._uploads == {}
+        # a complete upload still lands
+        ws.feed("FILE_UPLOAD_START:ok.bin:4")
+        ws.feed(b"\x01good")
+        ws.feed("FILE_UPLOAD_END:ok.bin")
+        assert await wait_until(lambda: (tmp_path / "ok.bin").exists())
+        assert (tmp_path / "ok.bin").read_bytes() == b"good"
+    finally:
+        await close_client(ws, task)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_orphan_file_chunks_are_metered():
+    """0x01 frames with no open upload must still charge the upload
+    pacer — a free unmetered byte lane would defeat the rate limiting."""
+    server = make_server(SELKIES_RATE_LIMITS="upload=1000:2000")
+    ws, task = await open_client(server, PRIMARY)
+    try:
+        ws.feed(b"\x01" + b"x" * 2100)     # no FILE_UPLOAD_START ever sent
+        ws.feed(b"\x01" + b"x" * 2100)
+        assert await wait_until(
+            lambda: server.edge_stats["upload_paced"] >= 1)
+        assert not ws.closed
+    finally:
+        await close_client(ws, task)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_superseded_upload_partial_unlinked(tmp_path, monkeypatch):
+    """A new FILE_UPLOAD_START while one is open must abort the old
+    transfer completely — fd closed AND the truncated partial removed."""
+    monkeypatch.setenv("SELKIES_UPLOAD_DIR", str(tmp_path))
+    server = make_server()
+    ws, task = await open_client(server, PRIMARY)
+    try:
+        ws.feed("FILE_UPLOAD_START:first.bin:1000")
+        ws.feed(b"\x01" + b"x" * 10)
+        assert await wait_until(lambda: (tmp_path / "first.bin").exists())
+        ws.feed("FILE_UPLOAD_START:second.bin:4")
+        ws.feed(b"\x01good")
+        ws.feed("FILE_UPLOAD_END:second.bin")
+        assert await wait_until(lambda: (tmp_path / "second.bin").exists())
+        assert not (tmp_path / "first.bin").exists()
+        assert server._uploads == {}
+    finally:
+        await close_client(ws, task)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_mic_chunk_cap_enforced():
+    server = make_server(SELKIES_MAX_MIC_CHUNK_KB="1")
+    seen = []
+
+    class FakeAudio:
+        running = True
+
+        async def on_mic_data(self, pcm):
+            seen.append(len(pcm))
+
+        async def start(self):
+            pass
+
+        async def stop(self):
+            pass
+
+        def close(self):
+            pass
+
+    server.audio_pipeline = FakeAudio()
+    ws, task = await open_client(server, PRIMARY)
+    try:
+        ws.feed(b"\x02" + b"\x00" * 512)          # under the 1 KiB cap
+        ws.feed(b"\x02" + b"\x00" * (64 * 1024))  # over: dropped + counted
+        assert await wait_until(lambda: seen == [512])
+        assert await wait_until(
+            lambda: server.edge_stats["protocol_errors"] >= 1)
+        assert not ws.closed
+    finally:
+        await close_client(ws, task)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_bad_setting_values_ignored_not_fatal():
+    """A garbage value inside SETTINGS costs only itself: the rest of the
+    payload applies, the display registers fully (no zombie holding a
+    max_displays slot), and nothing hits the error budget."""
+    server = make_server()
+    ws, task = await open_client(server, {
+        "displayId": "primary", "initialClientWidth": "garbage",
+        "initialClientHeight": 240, "framerate": "also-garbage",
+        "jpeg_quality": 77})
+    try:
+        assert await wait_until(lambda: "primary" in server.display_clients)
+        st = server.display_clients["primary"]
+        assert st.height == 240                  # good value applied
+        assert st.width == 1024                  # default kept, not zombie
+        assert st.overrides.get("jpeg_quality") == 77
+        assert await wait_until(lambda: ws.n_frames() >= 1)
+        assert server.edge_stats["protocol_errors"] == 0
+    finally:
+        await close_client(ws, task)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_transport_death_not_charged_as_abuse(tmp_path, monkeypatch):
+    """A handler failing to SEND to a dead peer ends the session like any
+    transport error — it must not count as a protocol error or burn the
+    abuse budget."""
+    monkeypatch.setenv("SELKIES_UPLOAD_DIR", str(tmp_path))
+    server = make_server()
+    ws, task = await open_client(server, PRIMARY)
+    try:
+        ws.feed("FILE_UPLOAD_START:x.bin:100")
+        ws.feed(b"\x01short")
+        assert await wait_until(lambda: ws in server._uploads)
+        ws.closed = True                   # peer died without a close frame
+        ws.feed("FILE_UPLOAD_END:x.bin")   # short-upload reply hits a corpse
+        await asyncio.wait_for(task, 10.0)
+        assert server.edge_stats["protocol_errors"] == 0
+        assert server._uploads == {}
+    finally:
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_viewer_cannot_mutate_owned_display():
+    """A shared-mode viewer must not stop, resize, or ACK-poison the
+    owner's display (the fuzzer found all three)."""
+    server = make_server()
+    owner, owner_task = await open_client(server, PRIMARY)
+    viewer, viewer_task = await open_client(server)
+    try:
+        assert await wait_until(lambda: "primary" in server.display_clients)
+        st = server.display_clients["primary"]
+        viewer.feed("STOP_VIDEO")
+        viewer.feed("r,640x480,primary")
+        viewer.feed("CLIENT_FRAME_ACK 40000")
+        await asyncio.sleep(0.3)
+        assert st.video_active
+        assert (st.width, st.height) == (320, 240)
+        assert st.bp.acknowledged_frame_id == -1
+        # the owner still can
+        owner.feed("CLIENT_FRAME_ACK 3")
+        assert await wait_until(lambda: st.bp.acknowledged_frame_id == 3)
+    finally:
+        await close_client(viewer, viewer_task)
+        await close_client(owner, owner_task)
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_resize_dimensions_clamped():
+    server = make_server(SELKIES_RESIZE_DEBOUNCE_MS="10")
+    ws, task = await open_client(server, PRIMARY)
+    try:
+        assert await wait_until(lambda: "primary" in server.display_clients)
+        st = server.display_clients["primary"]
+        ws.feed("r,1000000x1000000,primary")
+        assert await wait_until(lambda: st.width == 8192)
+        assert st.height == 8192
+        ws.feed("r,2x2,primary")
+        assert await wait_until(lambda: st.width == 16)
+    finally:
+        await close_client(ws, task)
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# slow: longer fuzz run (satellite: CI wiring like tools/chaos_run.py)
+
+
+@pytest.mark.slow
+@pytest.mark.anyio
+async def test_fuzz_long_run_survives(tmp_path, monkeypatch):
+    from tools.proto_fuzz import fuzz_session
+
+    monkeypatch.setenv("SELKIES_UPLOAD_DIR", str(tmp_path / "up"))
+    report = await fuzz_session(iterations=3000, seed=1234)
+    assert report["alive"], report
+    # and with a tiny budget, the abuse kill path fires without collateral
+    report2 = await fuzz_session(iterations=400, seed=99, error_budget=5)
+    assert report2["kills"] >= 1, report2
+    assert report2["premature_deaths"] == 0, report2
+    assert report2["observer_alive"], report2

@@ -1,0 +1,207 @@
+"""The parser as a trust boundary (ISSUE 3 satellite).
+
+``parse_text_message`` and ``unpack_client_binary`` face hostile input:
+these tests sweep the full verb grammar table (round trips), truncations,
+prefix confusion, wrong-direction frames, and oversize arguments. The
+invariant everywhere: a parse either returns a typed message or raises
+``ProtocolError``/``ValueError`` — never any other exception, never a
+misclassified verb.
+"""
+
+import random
+import string
+
+import pytest
+
+from selkies_tpu.protocol import (
+    BinaryType,
+    FileChunk,
+    MicChunk,
+    ProtocolError,
+    pack_file_chunk,
+    pack_mic_chunk,
+    parse_text_message,
+    unpack_binary,
+    unpack_client_binary,
+)
+
+# ---------------------------------------------------------------------------
+# round trips over the full client-verb grammar table (wire.py doc block)
+
+GRAMMAR_TABLE = [
+    # (message, verb, args)
+    ("SETTINGS,{}", "SETTINGS", ()),
+    ("CLIENT_FRAME_ACK 7", "CLIENT_FRAME_ACK", ("7",)),
+    ("CLIENT_FRAME_ACK", "CLIENT_FRAME_ACK", ()),
+    ("r,1920x1080,primary", "r", ("1920x1080", "primary")),
+    ("r,640x480", "r", ("640x480",)),
+    ("s,1.5", "s", ("1.5",)),
+    ("cmd,echo a,b c", "cmd", ("echo a,b c",)),
+    ("SET_NATIVE_CURSOR_RENDERING,1", "SET_NATIVE_CURSOR_RENDERING", ("1",)),
+    ("START_VIDEO", "START_VIDEO", ()),
+    ("STOP_VIDEO", "STOP_VIDEO", ()),
+    ("START_AUDIO", "START_AUDIO", ()),
+    ("STOP_AUDIO", "STOP_AUDIO", ()),
+    ("FILE_UPLOAD_START:a/b.txt:123", "FILE_UPLOAD_START", ("a/b.txt", "123")),
+    ("FILE_UPLOAD_END:a/b.txt", "FILE_UPLOAD_END", ("a/b.txt",)),
+    ("FILE_UPLOAD_ERROR:a.txt:oops", "FILE_UPLOAD_ERROR", ("a.txt", "oops")),
+    ("cr", "cr", ()),
+    ("cw,aGk=", "cw", ("aGk=",)),
+    ("cb,text/plain,aGk=", "cb", ("text/plain", "aGk=")),
+    ("cws,12", "cws", ("12",)),
+    ("cwd,aGk=", "cwd", ("aGk=",)),
+    ("cwe", "cwe", ()),
+    ("cbs,text/plain,9", "cbs", ("text/plain", "9")),
+    ("cbd,aGk=", "cbd", ("aGk=",)),
+    ("cbe", "cbe", ()),
+    ("kd,65", "kd", ("65",)),
+    ("ku,65", "ku", ("65",)),
+    ("kr", "kr", ()),
+    ("m,10,20,0,0", "m", ("10", "20", "0", "0")),
+    ("m2,-1,-2,4,1", "m2", ("-1", "-2", "4", "1")),
+    ("js,c,0,Xbox,1118,654", "js", ("c", "0", "Xbox", "1118", "654")),
+    ("js,b,0,3,1", "js", ("b", "0", "3", "1")),
+    ("js,a,0,1,0.5", "js", ("a", "0", "1", "0.5")),
+    ("js,d,0", "js", ("d", "0")),
+    ("_f 59.9", "_f", ("59.9",)),
+    ("_l 12.5", "_l", ("12.5",)),
+    ("pong", "pong", ()),
+    ("p,1", "p", ("1",)),
+    ("vb,4000", "vb", ("4000",)),
+    ("ab,128000", "ab", ("128000",)),
+]
+
+
+@pytest.mark.parametrize("raw,verb,args", GRAMMAR_TABLE)
+def test_grammar_round_trip(raw, verb, args):
+    m = parse_text_message(raw)
+    assert m.verb == verb
+    assert m.args == args
+
+
+def test_settings_json_body_preserved():
+    m = parse_text_message('SETTINGS,{"a": "b,c"}')
+    assert m.verb == "SETTINGS" and m.json_body == '{"a": "b,c"}'
+
+
+# ---------------------------------------------------------------------------
+# exact verb-plus-delimiter matching (no prefix confusion)
+
+
+@pytest.mark.parametrize("raw", [
+    "CLIENT_FRAME_ACKjunk",
+    "START_VIDEOO",
+    "_fjunk",
+    "_f5",                       # missing the space delimiter
+    "SETTINGSjunk",
+    "FILE_UPLOAD_STARTjunk",
+])
+def test_glued_verbs_are_not_their_prefix(raw):
+    m = parse_text_message(raw)
+    assert m.verb not in (
+        "CLIENT_FRAME_ACK", "START_VIDEO", "_f", "SETTINGS",
+        "FILE_UPLOAD_START"), raw
+
+
+# ---------------------------------------------------------------------------
+# server→client verbs are rejected from the client side
+
+
+@pytest.mark.parametrize("raw", [
+    "KILL",
+    "KILL go away",
+    "KILL,reason",
+    "PIPELINE_RESETTING primary",
+    "PIPELINE_RESETTING,primary",
+    "MODE websockets",
+    "VIDEO_STARTED",
+    "VIDEO_STOPPED",
+    "AUDIO_STARTED",
+    "AUDIO_STOPPED",
+])
+def test_server_only_verbs_rejected(raw):
+    with pytest.raises(ProtocolError):
+        parse_text_message(raw)
+
+
+def test_server_verb_lookalikes_are_unknown_not_rejected():
+    # "KILLx" is not KILL: it must not raise, just parse as unknown
+    assert parse_text_message("KILLx").verb == "KILLx"
+    assert parse_text_message("PIPELINE_RESETTINGx").verb == \
+        "PIPELINE_RESETTINGx"
+
+
+# ---------------------------------------------------------------------------
+# property-style sweep: parse never raises anything but ProtocolError
+
+
+def test_parse_total_over_mutations():
+    rng = random.Random(42)
+    corpus = [raw for raw, _, _ in GRAMMAR_TABLE]
+    alphabet = string.printable + "\x00\x7fé☃"
+    for _ in range(2000):
+        base = rng.choice(corpus)
+        kind = rng.randrange(4)
+        if kind == 0:
+            msg = base[:rng.randrange(len(base) + 1)]
+        elif kind == 1:
+            i = rng.randrange(len(base) + 1)
+            msg = base[:i] + "".join(rng.choice(alphabet)
+                                     for _ in range(rng.randrange(1, 6))) \
+                + base[i:]
+        elif kind == 2:
+            msg = base + rng.choice(",: ") + "A" * rng.randrange(0, 10000)
+        else:
+            msg = "".join(rng.choice(alphabet)
+                          for _ in range(rng.randrange(0, 200)))
+        try:
+            m = parse_text_message(msg)
+        except ProtocolError:
+            continue
+        assert isinstance(m.verb, str)
+        assert all(isinstance(a, str) for a in m.args)
+
+
+def test_oversize_args_parse_without_blowup():
+    huge = "r," + "9" * 100000 + "x" + "9" * 100000
+    m = parse_text_message(huge)
+    assert m.verb == "r" and len(m.args) == 1
+    m = parse_text_message("CLIENT_FRAME_ACK " + "1" * 100000)
+    assert m.verb == "CLIENT_FRAME_ACK"
+
+
+# ---------------------------------------------------------------------------
+# client binary plane: direction is part of the contract
+
+
+def test_client_binary_round_trip():
+    f = unpack_client_binary(pack_file_chunk(b"\x00\x01data"))
+    assert isinstance(f, FileChunk) and f.payload == b"\x00\x01data"
+    m = unpack_client_binary(pack_mic_chunk(b"\x00" * 32))
+    assert isinstance(m, MicChunk) and len(m.payload) == 32
+
+
+@pytest.mark.parametrize("t", [
+    int(BinaryType.H264_FULL_FRAME),
+    int(BinaryType.JPEG_STRIPE),
+    int(BinaryType.H264_STRIPE),
+])
+def test_wrong_direction_type_bytes_rejected(t):
+    with pytest.raises(ProtocolError):
+        unpack_client_binary(bytes([t]) + b"payload")
+
+
+def test_unknown_and_empty_client_binary_rejected():
+    with pytest.raises(ProtocolError):
+        unpack_client_binary(b"")
+    for t in (0x05, 0x10, 0x7f, 0xff):
+        with pytest.raises(ProtocolError):
+            unpack_client_binary(bytes([t]))
+
+
+def test_truncated_server_binary_still_rejected_as_valueerror():
+    # unpack_binary's truncation errors remain ValueError (ProtocolError
+    # subclasses it) — pre-existing callers keep working
+    for frame in (b"", b"\x00\x01", b"\x03\x00\x00", b"\x04" + b"\x00" * 5):
+        with pytest.raises(ValueError):
+            unpack_binary(frame)

@@ -41,24 +41,61 @@ FAULT_MENU = (
     ("encode.raise", 1, None),
     ("fetch.hang", 1, "0.4"),
     ("ws.drop", 1, None),
+    ("ws.flood", 1, None),
+    ("ws.garbage", 1, None),
 )
+
+#: edge fault kinds (ISSUE 3): injected from the CLIENT side — a message
+#: flood / garbage burst through the websocket, exercising the rate
+#: limiter and per-message exception boundary rather than a server-side
+#: fault point (server.faults has no call site that can forge client
+#: input)
+CLIENT_FAULTS = ("ws.flood", "ws.garbage")
 
 
 from selkies_tpu.robustness.testing import InProcessClient as _ChaosClient  # noqa: E402
+
+
+def _inject_client_fault(ws, point: str, rng) -> None:
+    """Feed a hostile burst through the in-process client."""
+    if point == "ws.flood":
+        # input-plane flood past the token bucket's burst (default 2000):
+        # the tail must be dropped by the limiter, none may kill the
+        # session or starve the capture loop
+        for i in range(3000):
+            ws.feed(f"m,{rng.randrange(2000)},{rng.randrange(2000)},0,0")
+    else:  # ws.garbage
+        from tools.proto_fuzz import gen_message
+
+        for _ in range(40):
+            ws.feed(gen_message(rng))
 
 
 async def chaos_session(duration_s: float = 10.0, seed: int = 0,
                         width: int = 160, height: int = 128,
                         fps: float = 30.0) -> dict:
     """Run one chaos session; returns the survival report."""
+    import tempfile
+
     from selkies_tpu.server.app import StreamingApp
     from selkies_tpu.server.data_server import (DataStreamingServer,
                                                 default_encoder_factory)
     from selkies_tpu.settings import Settings
 
+    # ws.garbage bursts may carry FILE_UPLOAD verbs: sandbox them
+    # (honoring a caller-provided dir, e.g. pytest's tmp_path)
+    if not os.environ.get("SELKIES_UPLOAD_DIR"):
+        os.environ["SELKIES_UPLOAD_DIR"] = tempfile.mkdtemp(
+            prefix="chaos_uploads_")
+
     env = {
         "SELKIES_PORT": "0",
         "SELKIES_AUDIO_ENABLED": "false",
+        # ws.garbage bursts carry arbitrary text: NEVER let one reach a
+        # shell, and never let a garbage SETTINGS spin up a second real
+        # encoder pipeline at a random geometry
+        "SELKIES_COMMAND_ENABLED": "false",
+        "SELKIES_MAX_DISPLAYS": "1",
         # generous budget: chaos injects faults far faster than production
         "SELKIES_SUPERVISOR_MAX_RESTARTS": "1000",
         "SELKIES_SUPERVISOR_RESTART_WINDOW_S": "60",
@@ -142,7 +179,10 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
                 ws, task = await connect()
                 reconnects += 1
             point, times, arg = FAULT_MENU[rng.randrange(len(FAULT_MENU))]
-            server.faults.arm(point, times=times, arg=arg)
+            if point in CLIENT_FAULTS:
+                _inject_client_fault(ws, point, rng)
+            else:
+                server.faults.arm(point, times=times, arg=arg)
             injected.append(point)
 
         # quiesce and verify recovery: no new faults, frames must flow
@@ -177,6 +217,10 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
             "rung": st.ladder.rung if st else None,
             "failed_displays": server._failed_displays(),
             "frames_delivered": ws.n_frames(),
+            "protocol_errors": server.edge_stats["protocol_errors"],
+            "rate_limited": dict(server.edge_stats["rate_limited"]),
+            "slow_client_evictions":
+                server.edge_stats["slow_client_evictions"],
             "alive": recovered and server._failed_displays() == 0,
         }
         return report
