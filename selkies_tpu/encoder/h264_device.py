@@ -27,7 +27,7 @@ turns them into Annex-B NAL units.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -746,6 +746,135 @@ def _pack_levels(enc: StripeEncodeOut, damage, update):
     flat8 = jnp.concatenate(
         [jnp.clip(flat16, -127, 127).astype(jnp.int8), tail], axis=1)
     return flat16, flat8
+
+
+@functools.partial(jax.jit, donate_argnames=("slot",))
+def _stage_into(slot, frame):
+    """H2D staging step for one ring slot.
+
+    ``slot`` is the retiring ring buffer (donated): XLA may write the
+    freshly transferred ``frame`` into its device memory instead of
+    allocating, so a ring of N slots bounds staging memory at N frames
+    no matter how many frames stream through. The elementwise merge is
+    the cheapest op that makes the output *computed* (eligible to alias
+    the donated operand) rather than a pass-through of the transfer
+    buffer.
+    """
+    return frame | (slot & 0)
+
+
+class StagingRing:
+    """Double-buffered (depth>=2) H2D staging lane with donated slots.
+
+    The pipelined encoders stage each host frame through here before
+    dispatch: while the device encodes the frame staged into slot A, the
+    host's next upload lands in slot B, so H2D transfer overlaps compute
+    and donation can never serialize two consecutive dispatches against
+    the same buffer.
+
+    Donation hazard: a slot handed to ``_stage_into`` is *deleted* at
+    call time — any later host read of that array would crash. ``stage``
+    therefore refuses to donate a slot whose ticket is still held by an
+    in-flight batch and falls back to a fresh allocation (counted in
+    ``stalls_total``) — correctness never depends on the caller sizing
+    the ring right, only peak memory does. tests/test_pipeline_async.py
+    pins the guard.
+    """
+
+    def __init__(self, depth: int = 2) -> None:
+        self.depth = max(2, int(depth))
+        #: shape/dtype-keyed slot lists — a resize or batch-size change
+        #: simply starts a new lane; stale lanes are dropped
+        self._slots: "list[object]" = [None] * self.depth
+        self._busy = [False] * self.depth
+        self._shape = None
+        self._next = 0
+        #: lane generation: tickets carry it so a ticket issued before a
+        #: shape change can never free (and thus re-donate) the NEW
+        #: lane's same-index slot while it is still in flight
+        self._generation = 0
+        self.stalls_total = 0
+        self.staged_total = 0
+
+    @property
+    def in_use(self) -> int:
+        return sum(self._busy)
+
+    def stage(self, frame) -> "tuple[jnp.ndarray, Optional[tuple]]":
+        """Stage one host frame; returns (device_array, ticket).
+
+        ticket is None when the ring stalled (every slot still in
+        flight) and a fresh unmanaged buffer was allocated instead.
+        Release the ticket via :meth:`release` once the consuming batch
+        has been harvested.
+        """
+        frame = jnp.asarray(frame)
+        key = (frame.shape, frame.dtype)
+        if key != self._shape:
+            # geometry change: abandon old slots (freed by GC) and
+            # restart the lane — donation needs shape-stable buffers.
+            # Outstanding tickets become stale via the generation bump.
+            self._shape = key
+            self._slots = [None] * self.depth
+            self._busy = [False] * self.depth
+            self._next = 0
+            self._generation += 1
+        idx = self._next
+        if self._busy[idx]:
+            # use-after-donate guard: a busy slot's occupant is still
+            # referenced by an in-flight batch — donating it would
+            # delete a buffer someone may read. Prefer ANY free slot
+            # (so one leaked slot costs capacity, never the whole
+            # lane); with every slot busy, allocate fresh instead.
+            free = next((i for i in range(self.depth)
+                         if not self._busy[i]), None)
+            if free is None:
+                self.stalls_total += 1
+                return frame, None
+            idx = free
+        if self._slots[idx] is None:
+            staged = frame
+        else:
+            staged = _stage_into(self._slots[idx], frame)
+        self._slots[idx] = staged
+        self._busy[idx] = True
+        self._next = (idx + 1) % self.depth
+        self.staged_total += 1
+        return staged, (self._generation, idx)
+
+    def release(self, ticket: "Optional[tuple]") -> None:
+        """Mark a slot's contents consumed (safe to donate again).
+        Tickets from a retired lane (pre-shape-change) are no-ops."""
+        if ticket is not None:
+            gen, idx = ticket
+            if gen == self._generation:
+                self._busy[idx] = False
+
+    def release_all(self) -> None:
+        """Teardown path: a closed pipeline holds no live readers, so
+        every slot becomes donatable — a restarted encoder must never
+        inherit a phantom-busy ring."""
+        self._busy = [False] * self.depth
+
+
+class StagingTicket:
+    """Refcounted handle shared by the frames of one staged batch: the
+    ring slot is released only after the LAST frame of the batch is
+    harvested (batch dispatches carry B frames on one staged buffer)."""
+
+    __slots__ = ("_ring", "_ticket", "_refs")
+
+    def __init__(self, ring: StagingRing, ticket: "Optional[int]",
+                 refs: int = 1) -> None:
+        self._ring = ring
+        self._ticket = ticket
+        self._refs = refs
+
+    def release(self) -> None:
+        self._refs -= 1
+        if self._refs <= 0 and self._ticket is not None:
+            self._ring.release(self._ticket)
+            self._ticket = None
 
 
 def prepare_planes(rgb: jnp.ndarray, pad_h: int, pad_w: int):

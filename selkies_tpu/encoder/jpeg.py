@@ -28,6 +28,7 @@ from ..ops.color import rgb_to_ycbcr, subsample_420
 from ..ops.dct import block_dct2, blockify
 from ..ops.quant import ZIGZAG, quality_scaled_tables
 from . import entropy_py
+from .h264_device import StagingRing
 from .jfif import EOI, jfif_headers
 from ..native import entropy_lib
 from .jpeg_tables import std_tables
@@ -234,6 +235,12 @@ class JpegStripeEncoder:
         self._static_frames = np.zeros(self.n_stripes, dtype=np.int64)
         self._painted = np.zeros(self.n_stripes, dtype=bool)
         self._first_frame = True
+        #: donated H2D staging lane (ISSUE 12): the synchronous
+        #: encode_frame path (host-entropy rung of the degradation
+        #: ladder included) double-buffers its uploads through the same
+        #: ring the async pipeline uses, instead of allocating per frame
+        self._staging = StagingRing(depth=2)
+        self._staging_ticket: Optional[tuple] = None
         self._wm_scaled, self._alpha_inv = self._load_watermark(
             watermark_path, watermark_location)
 
@@ -397,6 +404,16 @@ class JpegStripeEncoder:
                 scans[s] = stuff_bytes(raw[s])
         return scans
 
+    def _stage_frame(self, frame: np.ndarray):
+        """Stage one padded host frame through the donated ring.
+
+        encode_frame is synchronous (the previous frame was fully
+        fetched before this call), so the previous ticket is released
+        here and the two slots ping-pong."""
+        self._staging.release(self._staging_ticket)
+        staged, self._staging_ticket = self._staging.stage(frame)
+        return staged
+
     def encode_frame(self, frame: np.ndarray) -> List[StripeOutput]:
         """Encode one [H, W, 3] uint8 RGB frame; returns changed stripes only."""
         frame = self._pad(np.asarray(frame, dtype=np.uint8))
@@ -407,8 +424,8 @@ class JpegStripeEncoder:
 
         if self.entropy == "device":
             packed, new_prev, yq, cbq, crq = self._step(
-                jnp.asarray(frame), self._prev, self._qy, self._qc, qsel,
-                self._wm_scaled, self._alpha_inv)
+                self._stage_frame(frame), self._prev, self._qy, self._qc,
+                qsel, self._wm_scaled, self._alpha_inv)
             self._prev = new_prev
             mw = META_WORDS_PER_STRIPE * self.n_stripes
             head_np = np.asarray(packed[:mw])
@@ -426,7 +443,7 @@ class JpegStripeEncoder:
             return self._assemble(emit, is_paint, scans)
 
         yq, cbq, crq, damage, new_prev = _device_encode(
-            jnp.asarray(frame), self._prev, self._qy, self._qc, qsel,
+            self._stage_frame(frame), self._prev, self._qy, self._qc, qsel,
             stripe_h=self.stripe_h,
             wm_scaled=self._wm_scaled, alpha_inv=self._alpha_inv,
         )

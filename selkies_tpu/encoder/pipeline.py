@@ -29,7 +29,52 @@ from typing import Any, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from .h264_device import StagingRing, StagingTicket
 from .jpeg import META_WORDS_PER_STRIPE, JpegStripeEncoder, StripeOutput, split_meta
+
+
+def _p50(samples) -> float:
+    """Median of a bounded timing window (0.0 when empty)."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return float(s[len(s) // 2])
+
+
+class _PipelineTelemetry:
+    """Shared dispatch/fetch instrumentation for the pipelined encoders
+    (ISSUE 12): bounded timing windows, the in-flight high-water mark,
+    and the stats()/metrics publication — one implementation so the two
+    pipelines cannot drift. Subclasses provide ``inflight_batches`` and
+    a ``metrics`` attribute."""
+
+    def _init_telemetry(self) -> None:
+        self._dispatch_ms: deque = deque(maxlen=256)
+        self._fetch_wait_ms: deque = deque(maxlen=256)
+        self.inflight_batches_max = 0
+
+    def _note_inflight(self) -> None:
+        self.inflight_batches_max = max(self.inflight_batches_max,
+                                        self.inflight_batches)
+
+    def _record_dispatch(self, ms: float) -> None:
+        self._dispatch_ms.append(ms)
+        self._note_inflight()
+        if self.metrics is not None:
+            self.metrics.observe_dispatch(ms)
+
+    def _record_fetch_wait(self, ms: float) -> None:
+        self._fetch_wait_ms.append(ms)
+        if self.metrics is not None:
+            self.metrics.observe_fetch_wait(ms)
+
+    def _telemetry_stats(self) -> dict:
+        return {
+            "inflight_batches": self.inflight_batches,
+            "inflight_batches_max": self.inflight_batches_max,
+            "dispatch_p50_ms": round(_p50(self._dispatch_ms), 3),
+            "fetch_wait_p50_ms": round(_p50(self._fetch_wait_ms), 3),
+        }
 
 
 @dataclass
@@ -64,9 +109,10 @@ class _InFlight:
     refetch: Any = None             # second read when prediction missed
     meta: Tuple[Optional[np.ndarray], ...] = (None, None, None)
     words_np: Optional[np.ndarray] = None
+    ticket: Optional[StagingTicket] = None
 
 
-class PipelinedJpegEncoder:
+class PipelinedJpegEncoder(_PipelineTelemetry):
     """Depth-N pipelined wrapper around a device-entropy JpegStripeEncoder.
 
     Usage::
@@ -101,6 +147,22 @@ class PipelinedJpegEncoder:
         #: frames rejected by try_submit because the pipeline was full —
         #: surfaced in stats()/metrics instead of vanishing (ISSUE 2)
         self.frames_dropped_total = 0
+        #: donated H2D staging lane (ISSUE 12): host frames double-buffer
+        #: through a ring instead of allocating per dispatch, so upload
+        #: overlaps the previous frame's encode. Sized so every in-flight
+        #: frame can hold a slot without stalling the ring.
+        self._staging = StagingRing(depth=depth + 1)
+        self._init_telemetry()
+
+    @property
+    def inflight_batches(self) -> int:
+        """Fetch groups dispatched but not yet materialized on the host —
+        the ISSUE 12 acceptance gauge (>=2 in steady state means the chip
+        never waits on a lockstep host round trip). Dispatched-but-
+        ungrouped frames count as one forming group."""
+        groups = {id(it.group) for it in self._inflight
+                  if it.group is not None and it.group.host is None}
+        return len(groups) + (1 if self._unfetched else 0)
 
     def stats(self) -> dict:
         """Per-frame transfer/host-entropy gauges over the run so far."""
@@ -113,6 +175,8 @@ class PipelinedJpegEncoder:
             "host_fallback_stripes": getattr(
                 self.base, "host_fallback_stripes_total", 0),
             "entropy": self.base.entropy,
+            "staging_stalls": self._staging.stalls_total,
+            **self._telemetry_stats(),
         }
 
     def _publish_metrics(self) -> None:
@@ -121,6 +185,7 @@ class PipelinedJpegEncoder:
             self.metrics.set_d2h_bytes_per_frame(st["d2h_bytes_per_frame"])
             self.metrics.set_host_entropy_ms_per_frame(
                 st["host_entropy_ms_per_frame"])
+            self.metrics.set_inflight_batches(st["inflight_batches"])
 
     @property
     def n_inflight(self) -> int:
@@ -154,6 +219,8 @@ class PipelinedJpegEncoder:
 
     def _dispatch(self, frame) -> int:
         b = self.base
+        t0 = time.perf_counter()
+        ticket = None
         if isinstance(frame, jnp.ndarray):
             # Device-resident frame (e.g. DeviceScrollSource): must already
             # be padded to the encoder geometry; skips the host staging copy.
@@ -161,7 +228,24 @@ class PipelinedJpegEncoder:
                 raise ValueError(
                     f"device frame must be pre-padded to {(b.pad_h, b.pad_w, 3)}")
         else:
-            frame = jnp.asarray(b._pad(np.asarray(frame, dtype=np.uint8)))
+            # donated staging lane: the upload lands in a recycled ring
+            # slot and overlaps the in-flight frames' encode/fetch
+            frame, slot = self._staging.stage(
+                b._pad(np.asarray(frame, dtype=np.uint8)))
+            ticket = StagingTicket(self._staging, slot)
+            try:
+                return self._dispatch_staged(frame, ticket, t0)
+            except Exception:
+                # the slot must not leak busy; release via the ticket —
+                # idempotent, so a harvest that also releases (when the
+                # failure came after the in-flight item took ownership)
+                # cannot double-free a re-staged slot
+                ticket.release()
+                raise
+        return self._dispatch_staged(frame, ticket, t0)
+
+    def _dispatch_staged(self, frame, ticket, t0) -> int:
+        b = self.base
         paint_candidate = b._paint_candidates().copy()
         # Optimistic mark: frames submitted while this one is in flight must
         # not re-trigger the same paint-over (a damaged stripe clears the
@@ -174,13 +258,14 @@ class PipelinedJpegEncoder:
         b._prev = new_prev
         item = _InFlight(
             seq=self._seq, paint_candidate=paint_candidate,
-            packed=packed, yq=yq, cbq=cbq, crq=crq,
+            packed=packed, yq=yq, cbq=cbq, crq=crq, ticket=ticket,
         )
         self._seq += 1
         self._inflight.append(item)
         self._unfetched.append(item)
         if len(self._unfetched) >= self.fetch_group:
             self._issue_fetch()
+        self._record_dispatch((time.perf_counter() - t0) * 1000.0)
         self._advance_ready()
         return item.seq
 
@@ -200,6 +285,7 @@ class PipelinedJpegEncoder:
             it.group = group
             it.group_index = i
             it.guess_words = guess
+        self._note_inflight()
 
     # -- pipeline stages ---------------------------------------------------
 
@@ -228,7 +314,9 @@ class PipelinedJpegEncoder:
             if not block and not item.group.arr.is_ready():
                 return False
             if item.group.host is None:
+                t0 = time.perf_counter()
                 item.group.host = np.asarray(item.group.arr)
+                self._record_fetch_wait((time.perf_counter() - t0) * 1000.0)
                 self.d2h_bytes_total += item.group.host.nbytes
             stride = item.group.stride
             buf = item.group.host[item.group_index * stride:
@@ -263,6 +351,10 @@ class PipelinedJpegEncoder:
     def _finish(self, item: _InFlight) -> List[StripeOutput]:
         b = self.base
         self.frames_completed += 1
+        if item.ticket is not None:
+            # harvested: the staged input's ring slot is donatable again
+            item.ticket.release()
+            item.ticket = None
         nbytes_np, base_np, ovf_np = item.meta
         emit, is_paint = item.emit, item.is_paint
         if not emit.any() or item.words_np is None:
@@ -278,7 +370,15 @@ class PipelinedJpegEncoder:
 
     def _drain_one(self) -> Tuple[int, List[StripeOutput]]:
         item = self._inflight.popleft()
-        self._advance(item, block=True)
+        try:
+            self._advance(item, block=True)
+        except Exception:
+            # the item is already off the deque: a failed fetch must
+            # still free its staging slot, or the ring stalls forever
+            if item.ticket is not None:
+                item.ticket.release()
+                item.ticket = None
+            raise
         return item.seq, self._finish(item)
 
     # -- public harvest ----------------------------------------------------
@@ -292,22 +392,35 @@ class PipelinedJpegEncoder:
         low-latency choice for live streaming. Throughput-oriented
         callers that poll after every submit pass False so groups only
         ship at ``fetch_group`` size (``flush()`` remains the deadline).
+
+        Results accumulate in ``self._ready`` and are swapped out only
+        at the end: a harvest raising mid-pass must not discard frames
+        already completed this pass (they surface on the next call).
         """
-        out, self._ready = self._ready, []
         if self._unfetched and flush_partial:
             self._issue_fetch()
         self._advance_ready()
         while self._inflight and self._advance(self._inflight[0], block=False):
             item = self._inflight.popleft()
-            out.append((item.seq, self._finish(item)))
+            self._ready.append((item.seq, self._finish(item)))
+        out, self._ready = self._ready, []
         return out
 
     def flush(self) -> List[Tuple[int, List[StripeOutput]]]:
         """Drain the pipeline (blocking)."""
-        out, self._ready = self._ready, []
         while self._inflight:
-            out.append(self._drain_one())
+            self._ready.append(self._drain_one())
+        out, self._ready = self._ready, []
         return out
+
+    def close(self) -> None:
+        """Abandon in-flight work (display teardown / supervised restart):
+        drop device handles and release every staging slot so a rebuilt
+        pipeline never inherits a phantom-busy ring."""
+        self._inflight.clear()
+        self._unfetched.clear()
+        self._ready.clear()
+        self._staging.release_all()
 
 
 class ThreadedEncoderAdapter:
@@ -453,9 +566,10 @@ class _H264InFlight:
     group: Any = None                # _FetchGroup (P frames)
     group_index: int = 0
     host: Optional[np.ndarray] = None
+    ticket: Optional[StagingTicket] = None
 
 
-class PipelinedH264Encoder:
+class PipelinedH264Encoder(_PipelineTelemetry):
     """Depth-N pipelined wrapper around H264StripeEncoder with grouped
     sparse-buffer fetches.
 
@@ -484,25 +598,51 @@ class PipelinedH264Encoder:
         #: — RPC-attached transports pay per dispatch, so batch>1 divides
         #: that cost; PCIe deployments keep 1 (no added latency)
         self.batch = max(1, batch)
-        #: oldest-buffered-frame age at which poll(flush_partial=False)
-        #: dispatches a partial batch anyway — a caller that pauses
-        #: submission must not strand tail frames indefinitely. The
-        #: default scales with batch so a batch can actually FILL at
-        #: realistic frame rates (2.5 frame-times per slot at 60 fps)
-        #: before the deadline degrades it to single-frame dispatches.
+        #: inactivity deadline at which poll(flush_partial=False)
+        #: dispatches a partial batch anyway. RE-ARMED by every submit
+        #: (ISSUE 12 satellite): the deadline detects a PAUSED caller —
+        #: no new frame within the window — not a slow one, so a stream
+        #: ticking slower than batch/deadline still accumulates full
+        #: ``fetch_group`` batches instead of degrading to single-frame
+        #: dispatches forever (worst-case frame staleness stays bounded
+        #: at ``batch`` deadlines — see _batch_deadline_due).
         if batch_deadline_s is None:
             batch_deadline_s = max(0.05, 2.5 * self.batch / 60.0)
         self.batch_deadline_s = batch_deadline_s
-        self._batch_t0 = 0.0
+        self._batch_t0 = 0.0        # first frame of the forming group
+        self._batch_last = 0.0      # last submit — re-arms the deadline
         self._batch_frames: List[Any] = []
         self._inflight: deque[_H264InFlight] = deque()
         self._unfetched: List[_H264InFlight] = []
         self._ready: List[Tuple[int, list]] = []
         self._seq = 0
+        #: donated H2D staging lanes (ISSUE 12): one ring per input shape
+        #: — single frames and stacked batches ping-pong independently so
+        #: alternating paths never thrash a shared ring
+        self._staging = StagingRing(depth=depth + 1)
+        self._staging_batch = StagingRing(
+            depth=max(2, -(-depth // self.batch) + 1))
+        self._init_telemetry()
 
     @property
     def n_inflight(self) -> int:
         return len(self._inflight)
+
+    @property
+    def inflight_batches(self) -> int:
+        """Dispatched-but-not-yet-materialized fetch units: grouped P
+        reads, batch heads, and solo IDR flat16 fetches each count once
+        while their host copy is outstanding (the ISSUE 12 gauge)."""
+        groups = set()
+        solo = 0
+        for it in self._inflight:
+            if it.pending.is_idr:
+                if it.host is None:
+                    solo += 1
+            elif it.group is not None:
+                if it.group.host is None:
+                    groups.add(id(it.group))
+        return len(groups) + solo + (1 if self._unfetched else 0)
 
     def stats(self) -> dict:
         """Per-frame transfer/host-entropy gauges over the run so far.
@@ -520,6 +660,9 @@ class PipelinedH264Encoder:
             "frames_dropped": self.frames_dropped_total,
             "entropy_errors": getattr(self.base, "entropy_errors_total", 0),
             "entropy": getattr(self.base, "entropy", None),
+            "staging_stalls": (self._staging.stalls_total
+                               + self._staging_batch.stalls_total),
+            **self._telemetry_stats(),
         }
 
     def _publish_metrics(self) -> None:
@@ -528,6 +671,7 @@ class PipelinedH264Encoder:
             self.metrics.set_d2h_bytes_per_frame(st["d2h_bytes_per_frame"])
             self.metrics.set_host_entropy_ms_per_frame(
                 st["host_entropy_ms_per_frame"])
+            self.metrics.set_inflight_batches(st["inflight_batches"])
 
     def request_keyframe(self) -> None:
         self.base.request_keyframe()
@@ -550,6 +694,13 @@ class PipelinedH264Encoder:
             return None
         return self.submit(frame)
 
+    def _stage(self, frame, ring: StagingRing):
+        """Host frames ride the donated staging ring; device-resident
+        frames pass through untouched."""
+        if isinstance(frame, jnp.ndarray):
+            return frame, None
+        return ring.stage(np.asarray(frame, dtype=np.uint8))
+
     def submit(self, frame) -> int:
         while len(self._inflight) + len(self._batch_frames) >= self.depth:
             if not self._inflight:
@@ -558,14 +709,28 @@ class PipelinedH264Encoder:
             self._ready.append(self._drain_one())
         if self.batch > 1:
             seq = self._seq + len(self._batch_frames)
+            now = time.monotonic()
             if not self._batch_frames:
-                self._batch_t0 = time.monotonic()
+                self._batch_t0 = now
+            self._batch_last = now      # every submit re-arms the deadline
             self._batch_frames.append(frame)
             if len(self._batch_frames) >= self.batch:
                 self._flush_batch()
             return seq
-        p = self.base.dispatch(frame, fetch=False)
-        item = _H264InFlight(seq=self._seq, pending=p)
+        return self._dispatch_solo(frame)
+
+    def _dispatch_solo(self, frame) -> int:
+        t0 = time.perf_counter()
+        frame, slot = self._stage(frame, self._staging)
+        try:
+            p = self.base.dispatch(frame, fetch=False)
+        except Exception:
+            # no ticket exists yet: free the staged slot here or it
+            # leaks busy forever and the lane loses a buffer
+            self._staging.release(slot)
+            raise
+        item = _H264InFlight(seq=self._seq, pending=p,
+                             ticket=StagingTicket(self._staging, slot))
         self._seq += 1
         self._inflight.append(item)
         if p.is_idr:
@@ -575,6 +740,7 @@ class PipelinedH264Encoder:
             self._unfetched.append(item)
             if len(self._unfetched) >= self.fetch_group:
                 self._issue_fetch()
+        self._record_dispatch((time.perf_counter() - t0) * 1000.0)
         return item.seq
 
     def submit_batch(self, rgbs) -> List[int]:
@@ -593,33 +759,66 @@ class PipelinedH264Encoder:
         heads array doubles as the fetch group (one async read per
         batch). Partial batches go through the already-compiled
         single-frame program — a (B-k)-shaped batch scan would compile
-        from scratch for every distinct partial size."""
+        from scratch for every distinct partial size. A deadline flush
+        landing here re-arms nothing: the NEXT group's window starts at
+        its own first submit, so a resumed stream returns to full
+        batches immediately."""
         frames, self._batch_frames = self._batch_frames, []
         if not frames:
             return
         if len(frames) < self.batch:
-            for frame in frames:
-                p = self.base.dispatch(frame, fetch=False)
-                item = _H264InFlight(seq=self._seq, pending=p)
-                self._seq += 1
-                self._inflight.append(item)
-                if p.is_idr:
-                    p.flat16.copy_to_host_async()
-                else:
-                    self._unfetched.append(item)
+            for i, frame in enumerate(frames):
+                try:
+                    self._dispatch_solo(frame)
+                except Exception:
+                    # the raising frame is the caller's error to count;
+                    # the not-yet-attempted remainder must not vanish
+                    # silently — they are drops, visible to the ladder
+                    # and health feed
+                    self._count_dropped(len(frames) - i - 1)
+                    self._issue_fetch()
+                    raise
             self._issue_fetch()
             return
-        rgbs = jnp.stack([jnp.asarray(f) for f in frames])
-        self._dispatch_batch(rgbs)
+        if any(not isinstance(f, jnp.ndarray) for f in frames):
+            # host frames: stack host-side and stage the whole batch
+            # through the donated batch lane (ONE H2D upload)
+            rgbs = np.stack([np.asarray(f, dtype=np.uint8) for f in frames])
+        else:
+            rgbs = jnp.stack(frames)
+        try:
+            self._dispatch_batch(rgbs)
+        except Exception:
+            # one exception surfaces to the caller; the other B-1
+            # frames of the failed batch are accounted as drops
+            self._count_dropped(len(frames) - 1)
+            raise
+
+    def _count_dropped(self, n: int) -> None:
+        if n <= 0:
+            return
+        self.frames_dropped_total += n
+        if self.metrics is not None:
+            self.metrics.inc_frames_dropped(n)
 
     def _dispatch_batch(self, rgbs) -> None:
         # fetch=False: this pipeline owns every transfer — the encoder
         # starting its own head copies AND _issue_fetch concatenating the
         # same heads would double-transfer the IDR-recovery path
-        pendings = self.base.dispatch_batch(rgbs, fetch=False)
+        t0 = time.perf_counter()
+        rgbs, slot = self._stage(rgbs, self._staging_batch)
+        try:
+            pendings = self.base.dispatch_batch(rgbs, fetch=False)
+        except Exception:
+            self._staging_batch.release(slot)
+            raise
+        # one staged buffer backs every frame of the batch: the ring slot
+        # frees when the LAST of them harvests
+        ticket = StagingTicket(self._staging_batch, slot,
+                               refs=len(pendings))
         group_items = []
         for p in pendings:
-            item = _H264InFlight(seq=self._seq, pending=p)
+            item = _H264InFlight(seq=self._seq, pending=p, ticket=ticket)
             self._seq += 1
             self._inflight.append(item)
             if p.is_idr:
@@ -637,6 +836,7 @@ class PipelinedH264Encoder:
                 it.group_index = it.pending.batch_index
         if self._unfetched:
             self._issue_fetch()
+        self._record_dispatch((time.perf_counter() - t0) * 1000.0)
 
     def _issue_fetch(self) -> None:
         group_items, self._unfetched = self._unfetched, []
@@ -662,6 +862,7 @@ class PipelinedH264Encoder:
         for i, it in enumerate(group_items):
             it.group = group
             it.group_index = i
+        self._note_inflight()
 
     def _advance(self, item: _H264InFlight, block: bool) -> bool:
         p = item.pending
@@ -669,7 +870,9 @@ class PipelinedH264Encoder:
             if not block and not p.flat16.is_ready():
                 return False
             if item.host is None:
+                t0 = time.perf_counter()
                 item.host = np.asarray(p.flat16)
+                self._record_fetch_wait((time.perf_counter() - t0) * 1000.0)
                 self.d2h_bytes_total += item.host.nbytes
             return True
         if item.group is None:
@@ -679,7 +882,9 @@ class PipelinedH264Encoder:
         if not block and not item.group.arr.is_ready():
             return False
         if item.group.host is None:
+            t0 = time.perf_counter()
             item.group.host = np.asarray(item.group.arr)
+            self._record_fetch_wait((time.perf_counter() - t0) * 1000.0)
             self.d2h_bytes_total += item.group.host.nbytes
         if item.group.host.ndim == 2:      # batched dispatch: (B, prefix)
             item.host = item.group.host[item.group_index]
@@ -692,23 +897,53 @@ class PipelinedH264Encoder:
                                         (item.group_index + 1) * stride]
         return True
 
+    @staticmethod
+    def _release_ticket(item) -> None:
+        if item.ticket is not None:
+            item.ticket.release()
+            item.ticket = None
+
+    def _harvest_item(self, item: _H264InFlight) -> Tuple[int, list]:
+        try:
+            out = self.base.harvest(item.pending, host=item.host)
+        finally:
+            # the item is already off the deque: even a failed harvest
+            # must free its staging slot, or the ring stalls forever
+            self._release_ticket(item)
+        self.frames_completed += 1
+        return item.seq, out
+
     def _drain_one(self) -> Tuple[int, list]:
         # harvest() mutates per-stripe frame_num/static history, so frames
         # complete strictly in submission order (deque head first)
         item = self._inflight.popleft()
-        self._advance(item, block=True)
-        out = self.base.harvest(item.pending, host=item.host)
-        self.frames_completed += 1
+        try:
+            self._advance(item, block=True)
+        except Exception:
+            self._release_ticket(item)
+            raise
+        seq_out = self._harvest_item(item)
         self._publish_metrics()
-        return item.seq, out
+        return seq_out
+
+    def _batch_deadline_due(self) -> bool:
+        """True when the forming group should ship incomplete: the
+        caller went quiet for a full deadline since its LAST submit.
+        Staleness stays bounded without an extra age check — every
+        inter-submit gap under the deadline means the batch fills within
+        ``(batch-1)`` such gaps, so no frame ever waits longer than
+        ``batch * batch_deadline_s``."""
+        return time.monotonic() - self._batch_last > self.batch_deadline_s
 
     def poll(self, flush_partial: bool = True) -> List[Tuple[int, list]]:
         """Harvest completed frames in order; see PipelinedJpegEncoder.poll
-        for the ``flush_partial`` latency/throughput trade."""
-        out, self._ready = self._ready, []
-        if self._batch_frames and (
-                flush_partial
-                or time.monotonic() - self._batch_t0 > self.batch_deadline_s):
+        for the ``flush_partial`` latency/throughput trade.
+
+        Results accumulate in ``self._ready`` and are swapped out only at
+        the end: a harvest raising mid-pass must not discard the frames
+        already completed this pass (they surface on the next call)."""
+        if self._batch_frames and (flush_partial
+                                   or self._batch_deadline_due()):
             # deadline flush: frames buffered toward a batch must not wait
             # forever when the caller pauses submission
             self._flush_batch()
@@ -716,18 +951,16 @@ class PipelinedH264Encoder:
             self._issue_fetch()
         while self._inflight and self._advance(self._inflight[0],
                                                block=False):
-            item = self._inflight.popleft()
-            out.append((item.seq,
-                        self.base.harvest(item.pending, host=item.host)))
-            self.frames_completed += 1
+            self._ready.append(self._harvest_item(self._inflight.popleft()))
         self._publish_metrics()
+        out, self._ready = self._ready, []
         return out
 
     def flush(self) -> List[Tuple[int, list]]:
-        out, self._ready = self._ready, []
         self._flush_batch()
         while self._inflight:
-            out.append(self._drain_one())
+            self._ready.append(self._drain_one())
+        out, self._ready = self._ready, []
         return out
 
     def close(self) -> None:
@@ -735,3 +968,6 @@ class PipelinedH264Encoder:
         self._inflight.clear()
         self._unfetched.clear()
         self._ready.clear()
+        # a rebuilt pipeline must never inherit phantom-busy ring slots
+        self._staging.release_all()
+        self._staging_batch.release_all()

@@ -65,6 +65,25 @@ class Metrics:
             "wall time per frame (native CAVLC / overflow fallbacks; ~0 "
             "when the device entropy tiers carry steady state)",
             registry=self.registry)
+        # ISSUE 12: the dispatch/fetch-floor claims must stay measured —
+        # the async pipeline driver keeps >=2 batches in flight, and
+        # these series prove (or disprove) it per deployment
+        self.inflight_batches = Gauge(
+            "tpuenc_inflight_batches", "Encode batches dispatched but not "
+            "yet harvested (the async pipeline keeps >=2 in flight so the "
+            "chip never waits on a host round trip)",
+            registry=self.registry)
+        self.dispatch_ms = Histogram(
+            "tpuenc_dispatch_ms", "Host wall time to stage + dispatch one "
+            "encode batch (program launch, not device compute)",
+            buckets=(0.5, 1, 2, 4, 8, 16, 33, 66, 100, 250, float("inf")),
+            registry=self.registry)
+        self.fetch_wait_ms = Histogram(
+            "tpuenc_fetch_wait_ms", "Host wall time blocked materializing "
+            "an eagerly-started D2H fetch (~0 when the overlap hides the "
+            "transfer; the RPC floor when it does not)",
+            buckets=(0.5, 1, 2, 4, 8, 16, 33, 66, 100, 250, float("inf")),
+            registry=self.registry)
         # ISSUE 2: supervision / degradation observability — dropped and
         # errored frames were previously log lines only; restart and ladder
         # activity must be scrapeable to be actionable
@@ -161,6 +180,18 @@ class Metrics:
     def set_host_entropy_ms_per_frame(self, ms: float) -> None:
         if HAVE_PROM:
             self.host_entropy_ms_per_frame.set(ms)
+
+    def set_inflight_batches(self, n: int) -> None:
+        if HAVE_PROM:
+            self.inflight_batches.set(n)
+
+    def observe_dispatch(self, ms: float) -> None:
+        if HAVE_PROM:
+            self.dispatch_ms.observe(ms)
+
+    def observe_fetch_wait(self, ms: float) -> None:
+        if HAVE_PROM:
+            self.fetch_wait_ms.observe(ms)
 
     def inc_frames_dropped(self, n: int = 1) -> None:
         if HAVE_PROM and n > 0:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("selkies_tpu.parallel")
@@ -74,6 +75,7 @@ class MeshEncodeCoordinator:
         framerate: float = 60.0,
         stripe_h: int = 64,
         profile: str = "jpeg",
+        max_inflight: int = 2,
     ) -> None:
         from .mesh import MeshStripeEncoder, parse_mesh_spec
         from .mesh_h264 import MeshH264Encoder
@@ -123,8 +125,15 @@ class MeshEncodeCoordinator:
         self._seq: Dict[int, int] = {}
         self._want_key: set = set()
         self._want_reset: set = set()
-        self._inflight: Tuple[Optional[Any], List[int]] = (None, [])
+        #: bounded in-flight window (ISSUE 12): up to ``max_inflight``
+        #: dispatched ticks ride the device at once — dispatch of tick
+        #: N+1 overlaps the D2H fetch of tick N, the same discipline as
+        #: the solo async driver — drained oldest-first (harvest order
+        #: is mandatory: per-stripe host state advances per tick)
+        self.max_inflight = max(1, int(max_inflight))
+        self._inflight_q: "deque" = deque()   # (pending, [(slot, gen)])
         self._inflight_slots: set = set()
+        self.inflight_batches_max = 0
         self._kick = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -271,13 +280,54 @@ class MeshEncodeCoordinator:
                 "tick_errors_total": self.tick_errors_total,
                 "worker_restarts_total": self.worker_restarts_total,
                 "slot_errors": list(self.slot_errors),
+                "inflight_batches": len(self._inflight_q),
+                "inflight_batches_max": self.inflight_batches_max,
             }
 
+    def _recompute_inflight_slots_locked(self) -> None:
+        self._inflight_slots = {
+            s for _, took in self._inflight_q for s, _ in took}
+
+    def _fetch_ready(self, pending) -> bool:
+        ready = getattr(self.enc, "fetch_ready", None)
+        if ready is None:
+            return True
+        try:
+            return bool(ready(pending))
+        except Exception:
+            return True
+
+    def _harvest_oldest(self) -> None:
+        """Harvest the head of the in-flight window (dispatch order is
+        mandatory: per-stripe host state advances per tick)."""
+        pending, took = self._inflight_q[0]
+        try:
+            out, session_bytes = self.enc.harvest(pending)
+        except Exception:
+            with self._lock:
+                self._inflight_q.popleft()
+                for slot, _ in took:
+                    self.slot_errors[slot] += 1
+                self._recompute_inflight_slots_locked()
+            raise
+        with self._lock:
+            self._inflight_q.popleft()
+            self._recompute_inflight_slots_locked()
+            for slot, gen in took:
+                if slot not in self._attached or self._gen[slot] != gen:
+                    continue
+                self.coded_bytes[slot] += int(session_bytes[slot])
+                seq = self._seq[slot]
+                self._seq[slot] = seq + 1
+                self._results[slot].append((seq, out[slot]))
+
     def _tick(self) -> None:
-        """Dispatch this tick's frames, then harvest the *previous* tick's
-        dispatch — one step stays in flight so the device round trip is
-        hidden behind the next tick's work (depth-1 pipeline, same idea
-        as PipelinedJpegEncoder)."""
+        """Dispatch this tick's frames, then drain the in-flight window:
+        up to ``max_inflight`` dispatched ticks stay on the device at
+        once (their prefix fetches were started eagerly at dispatch), so
+        the round trip of tick N hides behind the compute of ticks
+        N+1..N+k — the same in-flight discipline as the solo async
+        pipeline driver (docs/pipeline.md)."""
         with self._lock:
             for slot in self._want_reset:
                 if slot in self._attached or slot in self._free:
@@ -294,6 +344,11 @@ class MeshEncodeCoordinator:
                     frames[slot] = self._pending.pop(slot)
                     took.append((slot, self._gen[slot]))
             self._inflight_slots |= {s for s, _ in took}
+        # make room FIRST: the window is a hard bound on dispatched-
+        # unharvested ticks, so a full window blocks on the oldest
+        # fetch BEFORE the new dispatch, never after
+        while took and len(self._inflight_q) >= self.max_inflight:
+            self._harvest_oldest()
         try:
             pending = self.enc.dispatch(frames) if took else None
         except Exception:
@@ -303,26 +358,15 @@ class MeshEncodeCoordinator:
             with self._lock:
                 for slot, _ in took:
                     self.slot_errors[slot] += 1
-                self._inflight_slots = {s for s, _ in self._inflight[1]}
+                self._recompute_inflight_slots_locked()
             raise
-        prev, self._inflight = self._inflight, (pending, took)
-        if prev is not None and prev[0] is not None:
-            try:
-                out, session_bytes = self.enc.harvest(prev[0])
-            except Exception:
-                with self._lock:
-                    for slot, _ in prev[1]:
-                        self.slot_errors[slot] += 1
-                    self._inflight_slots = {s for s, _ in self._inflight[1]}
-                raise
+        if pending is not None:
             with self._lock:
-                # a slot can be in BOTH the harvested and the new dispatch;
-                # recompute membership rather than discarding per slot
-                self._inflight_slots = {s for s, _ in self._inflight[1]}
-                for slot, gen in prev[1]:
-                    if slot not in self._attached or self._gen[slot] != gen:
-                        continue
-                    self.coded_bytes[slot] += int(session_bytes[slot])
-                    seq = self._seq[slot]
-                    self._seq[slot] = seq + 1
-                    self._results[slot].append((seq, out[slot]))
+                self._inflight_q.append((pending, took))
+                self.inflight_batches_max = max(self.inflight_batches_max,
+                                                len(self._inflight_q))
+        # opportunistic drain: only fetches that already landed are
+        # taken here, so this tick's dispatch is never stalled by a
+        # slow transfer (the window cap above is the blocking site)
+        while self._inflight_q and self._fetch_ready(self._inflight_q[0][0]):
+            self._harvest_oldest()

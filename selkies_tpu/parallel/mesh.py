@@ -453,6 +453,11 @@ class MeshStripeEncoder:
             paint_candidate=paint_candidate, reuse_prev=reuse_prev,
             first=first, stride=stride)
 
+    def fetch_ready(self, p: "_MeshPending") -> bool:
+        """True when the eagerly-started prefix fetch has landed — the
+        coordinator's in-flight window harvests without blocking then."""
+        return bool(p.prefix.is_ready())
+
     def harvest(self, p: "_MeshPending") -> Tuple[List[List], np.ndarray]:
         """Complete one dispatched step: returns (stripes_per_session,
         session_coded_bytes). Must be called in dispatch order."""

@@ -379,6 +379,11 @@ class MeshH264Encoder:
             prefix=prefix, buf=None, flat16=flat16, idr=idr,
             paint=paint, reuse_prev=reuse_prev, qp=qp_arr)
 
+    def fetch_ready(self, p: _MeshH264Pending) -> bool:
+        """True when the eagerly-started prefix fetch has landed — the
+        coordinator's in-flight window harvests without blocking then."""
+        return bool(p.prefix.is_ready())
+
     def harvest(self, p: _MeshH264Pending
                 ) -> Tuple[List[List[H264Stripe]], np.ndarray]:
         """Entropy-code one dispatched tick. Returns (stripes per session,

@@ -146,12 +146,29 @@ class FaultInjector:
         hang duration in seconds (default: effectively forever)."""
         arg, fired = self._take(point)
         if fired:
-            try:
-                duration = float(arg) if arg else DEFAULT_HANG_S
-            except ValueError:
-                duration = DEFAULT_HANG_S
-            logger.warning("fault %s: hanging %.1fs", point, duration)
+            duration = self._hang_duration(point, arg)
             await asyncio.sleep(duration)
+
+    def maybe_hang_sync(self, point: str) -> None:
+        """Thread-context counterpart of :meth:`maybe_hang` for call
+        sites that run off the event loop (the async encode driver's
+        fetch/harvest site): a plain blocking sleep, so chaos can stall
+        the driver thread exactly where a wedged D2H transfer would."""
+        arg, fired = self._take(point)
+        if fired:
+            import time
+
+            duration = self._hang_duration(point, arg)
+            time.sleep(duration)
+
+    @staticmethod
+    def _hang_duration(point: str, arg: Optional[str]) -> float:
+        try:
+            duration = float(arg) if arg else DEFAULT_HANG_S
+        except ValueError:
+            duration = DEFAULT_HANG_S
+        logger.warning("fault %s: hanging %.1fs", point, duration)
+        return duration
 
     def _take(self, point: str) -> Tuple[Optional[str], bool]:
         with self._lock:

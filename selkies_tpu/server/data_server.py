@@ -159,9 +159,26 @@ def default_encoder_factory(
     override: ``host`` builds the encoder with host-side entropy coding;
     the ladder's last rung additionally forces ``encoder=jpeg``. Entropy is
     fixed at construction (the device programs are compiled per tier), so a
-    rung change takes effect as a supervised pipeline restart."""
+    rung change takes effect as a supervised pipeline restart.
+
+    Device-entropy tiers ride the async pipeline driver (ISSUE 12,
+    docs/pipeline.md): a dedicated thread keeps >=2 batches in flight —
+    dispatch of batch N+1 overlapped with batch N's D2H fetch — so the
+    capture loop's submit/poll never touch the device and the served
+    encode latency tracks the chip, not the round-trip floor. Host
+    rungs keep the threaded adapter (their encode is synchronous by
+    construction)."""
+    from ..encoder.async_driver import AsyncEncodeDriver
     from ..encoder.jpeg import JpegStripeEncoder
-    from ..encoder.pipeline import PipelinedJpegEncoder, ThreadedEncoderAdapter
+    from ..encoder.pipeline import (PipelinedH264Encoder,
+                                    PipelinedJpegEncoder,
+                                    ThreadedEncoderAdapter)
+
+    #: frames encoded per device dispatch; >1 amortizes the fixed
+    #: dispatch RPC on tunneled transports at a latency cost — PCIe
+    #: deployments keep 1 (the re-armed batch deadline still bounds
+    #: staleness either way)
+    batch = max(1, int(os.environ.get("SELKIES_TPU_ASYNC_BATCH", "1")))
 
     ov = overrides or {}
     profile = ov.get("encoder", settings.encoder)
@@ -179,13 +196,23 @@ def default_encoder_factory(
         paint_crf = int(ov.get("h264_paintover_crf",
                                settings.h264_paintover_crf.default))
         even_w, even_h = width - width % 2, height - height % 2
-        return ThreadedEncoderAdapter(H264StripeEncoder(
+        base = H264StripeEncoder(
             even_w, even_h,
             stripe_height=int(settings.tpu_stripe_height),
             qp=crf, paint_over_qp=paint_crf,
             fullframe=(profile == "x264enc"),
             entropy=entropy,
-        ), depth=3, wire_fullframe=(profile == "x264enc"))
+        )
+        if base.entropy != "device":
+            # host-entropy rung: harvest is CPU-bound host CAVLC, the
+            # threaded adapter's one worker is the right shape for it
+            return ThreadedEncoderAdapter(
+                base, depth=3, wire_fullframe=(profile == "x264enc"))
+        return AsyncEncodeDriver(
+            PipelinedH264Encoder(base, depth=max(4, 3 * batch),
+                                 fetch_group=2, batch=batch),
+            flush_partial_when_idle=(batch == 1),
+            wire_fullframe=(profile == "x264enc"))
     base = JpegStripeEncoder(
         width,
         height,
@@ -206,7 +233,8 @@ def default_encoder_factory(
         # pipeline, so the synchronous encode_frame path runs off-loop in
         # the threaded adapter instead
         return ThreadedEncoderAdapter(base, depth=3)
-    return PipelinedJpegEncoder(base, depth=3)
+    return AsyncEncodeDriver(
+        PipelinedJpegEncoder(base, depth=4, fetch_group=2))
 
 
 def default_source_factory(width: int, height: int, fps: float,
@@ -1150,6 +1178,11 @@ class DataStreamingServer:
             # encode errors harvested off-loop (worker thread futures) feed
             # the same ladder as loop-crashing EncoderFaults
             encoder.on_error = lambda exc: st.ladder.record_failure()
+        if getattr(encoder, "faults", False) is None:
+            # the async driver checks fetch.hang at ITS harvest site, so
+            # one SELKIES_TPU_FAULTS entry can wedge either side of the
+            # D2H path (tools/chaos_run.py arms it for both)
+            encoder.faults = faults
         st.encoder = encoder
         source = None
         try:

@@ -437,8 +437,11 @@ def test_mesh_tick_failure_attributes_slots_and_unblocks_flush():
     coord._seq = {0: 0, 1: 0}
     coord._want_key = set()
     coord._want_reset = set()
-    coord._inflight = (None, [])
+    from collections import deque as _deque
+    coord._inflight_q = _deque()
     coord._inflight_slots = set()
+    coord.max_inflight = 2
+    coord.inflight_batches_max = 0
     coord._kick = threading.Event()
     coord._stop = threading.Event()
     coord._thread = None

@@ -34,12 +34,16 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 #: (point, times, arg) entries the chaos loop draws from — short hangs so
-#: a single run exercises both the hang-recovery and the watchdog paths
+#: a single run exercises both the hang-recovery and the watchdog paths.
+#: fetch.hang is armed twice per draw (ISSUE 12): the point now has TWO
+#: call sites — the capture loop's async stall and the async encode
+#: driver's harvest thread (encoder/async_driver.py) — so one draw can
+#: wedge either side of the D2H path.
 FAULT_MENU = (
     ("capture.raise", 1, None),
     ("capture.stall", 1, "0.4"),
     ("encode.raise", 1, None),
-    ("fetch.hang", 1, "0.4"),
+    ("fetch.hang", 2, "0.4"),
     ("ws.drop", 1, None),
     ("ws.flood", 1, None),
     ("ws.garbage", 1, None),
@@ -96,6 +100,13 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
         # encoder pipeline at a random geometry
         "SELKIES_COMMAND_ENABLED": "false",
         "SELKIES_MAX_DISPLAYS": "1",
+        # garbage "r,NxM" resizes are honored (clamped, owner-only) by
+        # design, but every fresh geometry is a full jit compile —
+        # minutes on this CPU host — which reads as a wedge and drowns
+        # the faults actually being tested. Resize handling is covered
+        # by tools/proto_fuzz.py + tests/test_edge.py against the edge;
+        # chaos pins the resolution and tests the supervision interior.
+        "SELKIES_IS_MANUAL_RESOLUTION_MODE": "true",
         # generous budget: chaos injects faults far faster than production
         "SELKIES_SUPERVISOR_MAX_RESTARTS": "1000",
         "SELKIES_SUPERVISOR_RESTART_WINDOW_S": "60",
