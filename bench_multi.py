@@ -62,8 +62,19 @@ def bench_mesh() -> dict:
     total_bytes = 0
     d2h_bytes = 0
     fetch_ms = []
+    dispatch_ms = []
+    pack_ms = []
     ticks = max(1, BENCH_FRAMES // n_sessions)
     from collections import deque
+
+    def dispatch_timed(b):
+        # per-shard stage truth (ISSUE 13 satellite): the mesh path's
+        # dispatch/fetch/pack decomposition, same stage names as the
+        # solo flight recorder so MULTICHIP and BENCH rows compare
+        t0 = time.perf_counter()
+        p = enc.dispatch(b)
+        dispatch_ms.append((time.perf_counter() - t0) * 1000.0)
+        return p
 
     def harvest_timed(p):
         # per-shard fetch truth (ISSUE 1 satellite — MULTICHIP files
@@ -72,9 +83,12 @@ def bench_mesh() -> dict:
         nonlocal d2h_bytes
         t0 = time.perf_counter()
         p.prefix.block_until_ready()
-        fetch_ms.append((time.perf_counter() - t0) * 1000.0)
+        t1 = time.perf_counter()
+        fetch_ms.append((t1 - t0) * 1000.0)
         d2h_bytes += int(np.prod(p.prefix.shape)) * p.prefix.dtype.itemsize
-        return enc.harvest(p)
+        out = enc.harvest(p)
+        pack_ms.append((time.perf_counter() - t1) * 1000.0)
+        return out
 
     start = time.perf_counter()
     pending = deque()
@@ -82,7 +96,7 @@ def bench_mesh() -> dict:
         if time.perf_counter() - start > MAX_SECONDS / 2:
             break
         batch = roll(batch)
-        pending.append(enc.dispatch(batch))  # overlap: 2 steps in flight
+        pending.append(dispatch_timed(batch))  # overlap: 2 steps in flight
         if len(pending) >= 3:
             out, _bytes = harvest_timed(pending.popleft())
             frames += sum(1 for s in out if s)
@@ -94,7 +108,22 @@ def bench_mesh() -> dict:
     elapsed = time.perf_counter() - start
     fps = frames / elapsed if elapsed > 0 else 0.0
     fetch_sorted = sorted(fetch_ms) or [0.0]
+
+    def p(vals, q):
+        s = sorted(vals) or [0.0]
+        return round(s[min(len(s) - 1, int(len(s) * q / 100))], 2)
+
     return {
+        # per-shard stage breakdown (tick-granular: one dispatch covers
+        # every shard's sessions, so per-frame cost is value/n_sessions)
+        "mesh_stage_breakdown": {
+            "dispatch": {"p50_ms": p(dispatch_ms, 50),
+                         "p95_ms": p(dispatch_ms, 95)},
+            "fetch_wait": {"p50_ms": p(fetch_ms, 50),
+                           "p95_ms": p(fetch_ms, 95)},
+            "pack": {"p50_ms": p(pack_ms, 50),
+                     "p95_ms": p(pack_ms, 95)},
+        },
         "mesh_aggregate_fps": round(fps, 2),
         "mesh_sessions": n_sessions,
         "mesh_devices": n_dev,

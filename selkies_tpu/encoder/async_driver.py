@@ -88,6 +88,11 @@ class AsyncEncodeDriver:
         self._cond = threading.Condition()
         self._in_q: deque = deque()          # (driver_seq, frame)
         self._out: deque = deque()           # (driver_seq, stripes)
+        #: driver_seq -> flight-recorder stage intervals harvested with
+        #: the frame (pulled from the pipe at emit time, under _cond, so
+        #: the event-loop pop never touches pipe state the driver thread
+        #: is mutating); bounded like the pipe's own trace store
+        self._trace_out: dict = {}
         #: pipe seq -> driver seq, recorded per successful submit: a
         #: frame the pipe never accepted has no entry, so its loss can
         #: never shift later results onto wrong driver seqs
@@ -226,12 +231,27 @@ class AsyncEncodeDriver:
 
     # -- driver thread ------------------------------------------------------
 
+    def pop_trace(self, seq: int):
+        """Stage intervals for a harvested frame, keyed by DRIVER seq
+        (the seq try_submit returned) — the capture loop's side of the
+        flight-recorder contract."""
+        with self._cond:
+            return self._trace_out.pop(seq, None)
+
     def _emit(self, results) -> None:
         if not results:
             return
+        pop_tr = getattr(self.pipe, "pop_trace", None)
         with self._cond:
             for pipe_seq, stripes in results:
                 seq = self._seq_map.pop(pipe_seq, pipe_seq)
+                if pop_tr is not None:
+                    tr = pop_tr(pipe_seq)
+                    if tr:
+                        self._trace_out[seq] = tr
+                        while len(self._trace_out) > 4 * self.submit_depth:
+                            self._trace_out.pop(
+                                next(iter(self._trace_out)))
                 self._out.append((seq, stripes))
             # results arrive in pipe order: mappings below the newest
             # emitted pipe seq belong to frames the pipe lost to errors

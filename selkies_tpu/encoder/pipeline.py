@@ -23,8 +23,8 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from dataclasses import dataclass
-from typing import Any, List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -46,12 +46,34 @@ class _PipelineTelemetry:
     (ISSUE 12): bounded timing windows, the in-flight high-water mark,
     and the stats()/metrics publication — one implementation so the two
     pipelines cannot drift. Subclasses provide ``inflight_batches`` and
-    a ``metrics`` attribute."""
+    a ``metrics`` attribute.
+
+    Flight-recorder hookup (ISSUE 13): per-frame stage intervals
+    (stage/dispatch/fetch_wait/pack, absolute ``time.monotonic``
+    ``(start, end)`` pairs) accumulate on the in-flight item and are
+    published under the frame's seq at harvest; the capture loop pops
+    them with :meth:`pop_trace` and folds them into that frame's
+    :class:`~selkies_tpu.observability.tracing.FrameTrace`."""
 
     def _init_telemetry(self) -> None:
         self._dispatch_ms: deque = deque(maxlen=256)
         self._fetch_wait_ms: deque = deque(maxlen=256)
         self.inflight_batches_max = 0
+        #: seq -> {stage: (t_start, t_end)} for harvested frames, pruned
+        #: oldest-first so an un-popping caller (bench loops, mesh) can
+        #: never grow it unboundedly
+        self._trace_out: "dict" = {}
+
+    def _trace_store(self, seq: int, intervals: dict) -> None:
+        if not intervals:
+            return
+        self._trace_out[seq] = intervals
+        while len(self._trace_out) > 4 * max(8, getattr(self, "depth", 8)):
+            self._trace_out.pop(next(iter(self._trace_out)))
+
+    def pop_trace(self, seq: int):
+        """Stage intervals for a harvested frame (once; None if unknown)."""
+        return self._trace_out.pop(seq, None)
 
     def _note_inflight(self) -> None:
         self.inflight_batches_max = max(self.inflight_batches_max,
@@ -90,6 +112,9 @@ class _FetchGroup:
     #: per-member (start, length) when member sizes differ (the H.264
     #: two-tier head prefixes); empty → uniform stride slicing
     offsets: Tuple[Tuple[int, int], ...] = ()
+    #: host-blocked interval materializing this group's copy (shared by
+    #: every member frame's trace: the wait gated them all)
+    fetch_iv: Optional[Tuple[float, float]] = None
 
 
 @dataclass
@@ -110,6 +135,8 @@ class _InFlight:
     meta: Tuple[Optional[np.ndarray], ...] = (None, None, None)
     words_np: Optional[np.ndarray] = None
     ticket: Optional[StagingTicket] = None
+    #: per-frame stage intervals for the flight recorder
+    trace: Dict[str, Tuple[float, float]] = field(default_factory=dict)
 
 
 class PipelinedJpegEncoder(_PipelineTelemetry):
@@ -220,7 +247,9 @@ class PipelinedJpegEncoder(_PipelineTelemetry):
     def _dispatch(self, frame) -> int:
         b = self.base
         t0 = time.perf_counter()
+        tm0 = time.monotonic()
         ticket = None
+        stage_iv = None
         if isinstance(frame, jnp.ndarray):
             # Device-resident frame (e.g. DeviceScrollSource): must already
             # be padded to the encoder geometry; skips the host staging copy.
@@ -232,9 +261,10 @@ class PipelinedJpegEncoder(_PipelineTelemetry):
             # slot and overlaps the in-flight frames' encode/fetch
             frame, slot = self._staging.stage(
                 b._pad(np.asarray(frame, dtype=np.uint8)))
+            stage_iv = (tm0, time.monotonic())
             ticket = StagingTicket(self._staging, slot)
             try:
-                return self._dispatch_staged(frame, ticket, t0)
+                return self._dispatch_staged(frame, ticket, t0, stage_iv)
             except Exception:
                 # the slot must not leak busy; release via the ticket —
                 # idempotent, so a harvest that also releases (when the
@@ -242,10 +272,11 @@ class PipelinedJpegEncoder(_PipelineTelemetry):
                 # cannot double-free a re-staged slot
                 ticket.release()
                 raise
-        return self._dispatch_staged(frame, ticket, t0)
+        return self._dispatch_staged(frame, ticket, t0, stage_iv)
 
-    def _dispatch_staged(self, frame, ticket, t0) -> int:
+    def _dispatch_staged(self, frame, ticket, t0, stage_iv=None) -> int:
         b = self.base
+        td0 = time.monotonic()
         paint_candidate = b._paint_candidates().copy()
         # Optimistic mark: frames submitted while this one is in flight must
         # not re-trigger the same paint-over (a damaged stripe clears the
@@ -260,6 +291,9 @@ class PipelinedJpegEncoder(_PipelineTelemetry):
             seq=self._seq, paint_candidate=paint_candidate,
             packed=packed, yq=yq, cbq=cbq, crq=crq, ticket=ticket,
         )
+        if stage_iv is not None:
+            item.trace["stage"] = stage_iv
+        item.trace["dispatch"] = (td0, time.monotonic())
         self._seq += 1
         self._inflight.append(item)
         self._unfetched.append(item)
@@ -315,9 +349,13 @@ class PipelinedJpegEncoder(_PipelineTelemetry):
                 return False
             if item.group.host is None:
                 t0 = time.perf_counter()
+                tm0 = time.monotonic()
                 item.group.host = np.asarray(item.group.arr)
+                item.group.fetch_iv = (tm0, time.monotonic())
                 self._record_fetch_wait((time.perf_counter() - t0) * 1000.0)
                 self.d2h_bytes_total += item.group.host.nbytes
+            if item.group.fetch_iv is not None:
+                item.trace["fetch_wait"] = item.group.fetch_iv
             stride = item.group.stride
             buf = item.group.host[item.group_index * stride:
                                   (item.group_index + 1) * stride]
@@ -344,7 +382,12 @@ class PipelinedJpegEncoder(_PipelineTelemetry):
         if item.refetch is not None and item.words_np is None:
             if not block and not item.refetch.is_ready():
                 return False
+            tm0 = time.monotonic()
             item.words_np = np.asarray(item.refetch)
+            # a prediction-miss second read extends the frame's fetch wait
+            fw = item.trace.get("fetch_wait")
+            item.trace["fetch_wait"] = (fw[0] if fw else tm0,
+                                        time.monotonic())
             self.d2h_bytes_total += item.words_np.nbytes
         return True
 
@@ -358,13 +401,17 @@ class PipelinedJpegEncoder(_PipelineTelemetry):
         nbytes_np, base_np, ovf_np = item.meta
         emit, is_paint = item.emit, item.is_paint
         if not emit.any() or item.words_np is None:
+            self._trace_store(item.seq, item.trace)
             return []
         t0 = time.monotonic()
         scans = b._scans_from_packed(
             item.words_np, base_np, nbytes_np, ovf_np,
             emit, item.yq, item.cbq, item.crq)
         out = b._assemble(emit, is_paint, scans)
-        self.host_entropy_ms_total += (time.monotonic() - t0) * 1000.0
+        t1 = time.monotonic()
+        item.trace["pack"] = (t0, t1)
+        self._trace_store(item.seq, item.trace)
+        self.host_entropy_ms_total += (t1 - t0) * 1000.0
         self._publish_metrics()
         return out
 
@@ -420,6 +467,7 @@ class PipelinedJpegEncoder(_PipelineTelemetry):
         self._inflight.clear()
         self._unfetched.clear()
         self._ready.clear()
+        self._trace_out.clear()
         self._staging.release_all()
 
 
@@ -451,6 +499,9 @@ class ThreadedEncoderAdapter:
         self.frames_completed = 0
         self.frames_dropped_total = 0
         self.encode_errors_total = 0
+        #: flight-recorder intervals (the synchronous host encode is all
+        #: "pack" — there is no separate device dispatch to attribute)
+        self._trace_out: dict = {}
 
     def stats(self) -> dict:
         """Drop/error accounting plus the base encoder's entropy gauges
@@ -476,11 +527,19 @@ class ThreadedEncoderAdapter:
             return None
         return self.submit(frame)
 
+    def pop_trace(self, seq: int):
+        """Stage intervals for a harvested frame (once; None if unknown)."""
+        return self._trace_out.pop(seq, None)
+
     def _settle(self, seq: int, fut, out: List) -> None:
         """Resolve one finished encode future into ``out`` with full
         error accounting (shared by the poll and flush drains)."""
         try:
-            out.append((seq, fut.result()))
+            stripes, iv = fut.result()
+            out.append((seq, stripes))
+            self._trace_out[seq] = {"pack": iv}
+            while len(self._trace_out) > 4 * max(8, self.depth):
+                self._trace_out.pop(next(iter(self._trace_out)))
             self.frames_completed += 1
         except Exception as exc:
             # encoder error: the frame is lost, but it must be COUNTED
@@ -515,8 +574,14 @@ class ThreadedEncoderAdapter:
         seq = self._seq
         self._seq += 1
         self._pending.append(
-            (seq, self._pool.submit(self.base.encode_frame, frame)))
+            (seq, self._pool.submit(self._timed_encode, frame)))
         return seq
+
+    def _timed_encode(self, frame):
+        """Worker-side encode wrapped with its flight-recorder interval."""
+        t0 = time.monotonic()
+        out = self.base.encode_frame(frame)
+        return out, (t0, time.monotonic())
 
     # control surface passthrough (PLI/viewer-join keyframes, rate control)
     def request_keyframe(self) -> None:
@@ -557,6 +622,7 @@ class ThreadedEncoderAdapter:
         self._pool.shutdown(wait=False, cancel_futures=True)
         self._pending.clear()
         self._done.clear()
+        self._trace_out.clear()
 
 
 @dataclass
@@ -567,6 +633,8 @@ class _H264InFlight:
     group_index: int = 0
     host: Optional[np.ndarray] = None
     ticket: Optional[StagingTicket] = None
+    #: per-frame stage intervals for the flight recorder
+    trace: Dict[str, Tuple[float, float]] = field(default_factory=dict)
 
 
 class PipelinedH264Encoder(_PipelineTelemetry):
@@ -721,7 +789,9 @@ class PipelinedH264Encoder(_PipelineTelemetry):
 
     def _dispatch_solo(self, frame) -> int:
         t0 = time.perf_counter()
+        ts0 = time.monotonic()
         frame, slot = self._stage(frame, self._staging)
+        td0 = time.monotonic()
         try:
             p = self.base.dispatch(frame, fetch=False)
         except Exception:
@@ -731,6 +801,9 @@ class PipelinedH264Encoder(_PipelineTelemetry):
             raise
         item = _H264InFlight(seq=self._seq, pending=p,
                              ticket=StagingTicket(self._staging, slot))
+        if slot is not None:
+            item.trace["stage"] = (ts0, td0)
+        item.trace["dispatch"] = (td0, time.monotonic())
         self._seq += 1
         self._inflight.append(item)
         if p.is_idr:
@@ -806,12 +879,15 @@ class PipelinedH264Encoder(_PipelineTelemetry):
         # starting its own head copies AND _issue_fetch concatenating the
         # same heads would double-transfer the IDR-recovery path
         t0 = time.perf_counter()
+        ts0 = time.monotonic()
         rgbs, slot = self._stage(rgbs, self._staging_batch)
+        td0 = time.monotonic()
         try:
             pendings = self.base.dispatch_batch(rgbs, fetch=False)
         except Exception:
             self._staging_batch.release(slot)
             raise
+        td1 = time.monotonic()
         # one staged buffer backs every frame of the batch: the ring slot
         # frees when the LAST of them harvests
         ticket = StagingTicket(self._staging_batch, slot,
@@ -819,6 +895,11 @@ class PipelinedH264Encoder(_PipelineTelemetry):
         group_items = []
         for p in pendings:
             item = _H264InFlight(seq=self._seq, pending=p, ticket=ticket)
+            # one staged buffer + one program back the whole batch, so
+            # every member frame was gated by the same intervals
+            if slot is not None:
+                item.trace["stage"] = (ts0, td0)
+            item.trace["dispatch"] = (td0, td1)
             self._seq += 1
             self._inflight.append(item)
             if p.is_idr:
@@ -871,7 +952,9 @@ class PipelinedH264Encoder(_PipelineTelemetry):
                 return False
             if item.host is None:
                 t0 = time.perf_counter()
+                tm0 = time.monotonic()
                 item.host = np.asarray(p.flat16)
+                item.trace["fetch_wait"] = (tm0, time.monotonic())
                 self._record_fetch_wait((time.perf_counter() - t0) * 1000.0)
                 self.d2h_bytes_total += item.host.nbytes
             return True
@@ -883,9 +966,13 @@ class PipelinedH264Encoder(_PipelineTelemetry):
             return False
         if item.group.host is None:
             t0 = time.perf_counter()
+            tm0 = time.monotonic()
             item.group.host = np.asarray(item.group.arr)
+            item.group.fetch_iv = (tm0, time.monotonic())
             self._record_fetch_wait((time.perf_counter() - t0) * 1000.0)
             self.d2h_bytes_total += item.group.host.nbytes
+        if item.group.fetch_iv is not None:
+            item.trace["fetch_wait"] = item.group.fetch_iv
         if item.group.host.ndim == 2:      # batched dispatch: (B, prefix)
             item.host = item.group.host[item.group_index]
         elif item.group.offsets:
@@ -904,12 +991,15 @@ class PipelinedH264Encoder(_PipelineTelemetry):
             item.ticket = None
 
     def _harvest_item(self, item: _H264InFlight) -> Tuple[int, list]:
+        t0 = time.monotonic()
         try:
             out = self.base.harvest(item.pending, host=item.host)
         finally:
             # the item is already off the deque: even a failed harvest
             # must free its staging slot, or the ring stalls forever
             self._release_ticket(item)
+        item.trace["pack"] = (t0, time.monotonic())
+        self._trace_store(item.seq, item.trace)
         self.frames_completed += 1
         return item.seq, out
 
@@ -968,6 +1058,7 @@ class PipelinedH264Encoder(_PipelineTelemetry):
         self._inflight.clear()
         self._unfetched.clear()
         self._ready.clear()
+        self._trace_out.clear()
         # a rebuilt pipeline must never inherit phantom-busy ring slots
         self._staging.release_all()
         self._staging_batch.release_all()

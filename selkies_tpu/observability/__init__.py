@@ -1,11 +1,25 @@
-"""Observability: Prometheus metrics + per-stage frame tracing.
+"""Observability: Prometheus metrics + the frame flight recorder.
 
-Parity targets: ``legacy/metrics.py`` (Prometheus gauges/histogram/Info on
-:8000, WebRTC-stats CSV dump) and the SURVEY §5 tracing gap (the reference
-has no tracer; we add per-stage timestamps around the encode path).
+Two surfaces (docs/observability.md):
+
+* :class:`Metrics` — the Prometheus registry (parity with
+  ``legacy/metrics.py`` gauges plus the tpuenc/robustness/edge series)
+  and the observability HTTP endpoint: ``/metrics``, ``/healthz``,
+  ``/debug/trace`` (Perfetto-loadable flight-recorder export), and the
+  opt-in ``/debug/jax-trace`` profiler hook.
+* :class:`FlightRecorder` / :class:`FrameTrace` — per-frame stage
+  tracing from capture to CLIENT_FRAME_ACK (:data:`STAGES`), the
+  measurement substrate behind ``glass_to_glass_ms`` /
+  ``encode_only_ms``, the ``system_health`` stage breakdown, and
+  tools/trace_report.py.
+
+``FrameTracer``/``StageSpan`` are the pre-recorder stamp-based API,
+kept as a compatibility shim.
 """
 
 from .metrics import Metrics
-from .tracing import FrameTracer, StageSpan
+from .tracing import (STAGES, FlightRecorder, FrameTrace, FrameTracer,
+                      StageSpan)
 
-__all__ = ["Metrics", "FrameTracer", "StageSpan"]
+__all__ = ["Metrics", "FlightRecorder", "FrameTrace", "STAGES",
+           "FrameTracer", "StageSpan"]

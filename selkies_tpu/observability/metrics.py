@@ -1,16 +1,33 @@
-"""Prometheus metrics endpoint.
+"""Prometheus metrics + observability HTTP endpoint.
 
 Parity with ``legacy/metrics.py:39-75``: ``fps`` gauge, ``fps_hist``
 histogram, ``gpu_utilization`` (here: TPU duty estimate), ``latency``
 gauge, and a ``webrtc_statistics`` Info — plus tpuenc-specific series
-(encode ms, stripe bytes, backpressure state). Falls back to a no-op
-registry when prometheus_client is unavailable so the server never grows
-a hard dependency.
+(encode ms, stripe bytes, backpressure state) and the flight-recorder
+stage series (docs/observability.md). Falls back to a no-op registry
+when prometheus_client is unavailable so the server never grows a hard
+dependency.
+
+The HTTP side is our own threaded server rather than
+``prometheus_client.start_http_server`` because the port carries more
+than the exposition: ``/healthz`` (liveness), ``/debug/trace`` (the
+flight recorder's Perfetto-loadable capture of the last N seconds) and
+``/debug/jax-trace`` (an on-demand ``jax.profiler`` capture, guarded by
+the ``jax_trace_enabled`` setting). A bind failure logs and disables
+the endpoint — it never takes the data server down with it.
+
+Every series registered here must be documented in
+docs/observability.md; tools/metrics_lint.py (tier-1) enforces the
+correspondence in both directions.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import tempfile
+import threading
 from typing import Dict, Optional
 
 logger = logging.getLogger("selkies_tpu.observability.metrics")
@@ -18,7 +35,7 @@ logger = logging.getLogger("selkies_tpu.observability.metrics")
 try:
     import prometheus_client as prom
     from prometheus_client import (CollectorRegistry, Counter, Gauge,
-                                   Histogram, Info, start_http_server)
+                                   Histogram, Info)
     HAVE_PROM = True
 except Exception:  # pragma: no cover
     HAVE_PROM = False
@@ -28,6 +45,18 @@ class Metrics:
     def __init__(self, port: int = 8000):
         self.port = port
         self._started = False
+        self._httpd = None
+        self._http_thread = None
+        #: actual bound port once start_http succeeds (port=0 binds
+        #: ephemeral — tests use this)
+        self.http_port: Optional[int] = None
+        #: the server's FlightRecorder, wired by main()/bench so
+        #: /debug/trace can export it (None -> endpoint answers 503)
+        self.recorder = None
+        #: /debug/jax-trace is an on-demand profiler with filesystem
+        #: side effects: disabled unless the operator opts in
+        #: (jax_trace_enabled setting)
+        self.jax_trace_enabled = False
         if not HAVE_PROM:  # pragma: no cover
             return
         self.registry = CollectorRegistry()
@@ -138,6 +167,32 @@ class Metrics:
             "reconfigure_coalesced_total", "Resize/SETTINGS requests "
             "absorbed into an already-scheduled display reconfiguration",
             registry=self.registry)
+        # ISSUE 13: flight-recorder stage series — the per-stage latency
+        # decomposition behind the glass-to-glass number, labeled by
+        # display so a sick session is attributable (docs/observability.md)
+        _stage_buckets = (0.25, 0.5, 1, 2, 4, 8, 16, 33, 66, 100, 250,
+                         500, 1000, float("inf"))
+        self.frame_stage_ms = Histogram(
+            "frame_stage_ms", "Per-frame wall time in one pipeline stage "
+            "(capture/stage/dispatch/fetch_wait/pack/queue/send/ack)",
+            ("stage", "display"), buckets=_stage_buckets,
+            registry=self.registry)
+        self.glass_to_glass_ms = Histogram(
+            "glass_to_glass_ms", "Capture start to CLIENT_FRAME_ACK per "
+            "acked frame (the latency the user feels)",
+            ("display",), buckets=_stage_buckets, registry=self.registry)
+        self.encode_only_ms = Histogram(
+            "encode_only_ms", "Submit to stripes-host-packed per frame "
+            "(the ROADMAP item 1 criterion vs device ms/frame)",
+            ("display",), buckets=_stage_buckets, registry=self.registry)
+        self.trace_open_spans = Gauge(
+            "trace_open_spans", "Frame spans opened but not yet terminal "
+            "(a steady nonzero residue means a span leak)",
+            registry=self.registry)
+        self.trace_dropped = Counter(
+            "trace_dropped_total", "Frame spans closed with a dropped@/"
+            "expired@ terminal mark, by the stage that lost them",
+            ("stage",), registry=self.registry)
         self.clients = Gauge("connected_clients", "WebSocket clients",
                              registry=self.registry)
         self.backpressured = Gauge(
@@ -146,11 +201,44 @@ class Metrics:
         self.webrtc_stats = Info("webrtc_statistics", "Last WebRTC stats",
                                  registry=self.registry)
 
-    def start_http(self) -> None:
-        """Expose /metrics (parity with legacy Metrics.start_http)."""
-        if HAVE_PROM and not self._started:
-            start_http_server(self.port, registry=self.registry)
-            self._started = True
+    def start_http(self) -> bool:
+        """Expose /metrics + /healthz + /debug/trace [+ /debug/jax-trace]
+        (parity with legacy Metrics.start_http, plus the observability
+        surface). A bind failure is NON-FATAL: it logs, leaves the
+        endpoint disabled, and returns False — a busy metrics port must
+        never crash the data server."""
+        if self._started:
+            return True
+        from http.server import ThreadingHTTPServer
+
+        try:
+            self._httpd = ThreadingHTTPServer(
+                ("0.0.0.0", int(self.port)),
+                _make_observability_handler())
+        except OSError as e:
+            logger.error("metrics http bind failed on :%s (%s); metrics "
+                         "endpoint disabled", self.port, e)
+            self._httpd = None
+            return False
+        self._httpd.daemon_threads = True
+        self._httpd.metrics = self
+        self.http_port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http",
+            daemon=True)
+        self._http_thread.start()
+        self._started = True
+        logger.info("observability http on :%d (/metrics /healthz "
+                    "/debug/trace%s)", self.http_port,
+                    " /debug/jax-trace" if self.jax_trace_enabled else "")
+        return True
+
+    def stop_http(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._started = False
 
     # no-op-safe setters -------------------------------------------------
 
@@ -192,6 +280,27 @@ class Metrics:
     def observe_fetch_wait(self, ms: float) -> None:
         if HAVE_PROM:
             self.fetch_wait_ms.observe(ms)
+
+    def observe_stage(self, display: str, stage: str, ms: float) -> None:
+        if HAVE_PROM:
+            self.frame_stage_ms.labels(stage=stage, display=display) \
+                .observe(ms)
+
+    def observe_glass_to_glass(self, display: str, ms: float) -> None:
+        if HAVE_PROM:
+            self.glass_to_glass_ms.labels(display=display).observe(ms)
+
+    def observe_encode_only(self, display: str, ms: float) -> None:
+        if HAVE_PROM:
+            self.encode_only_ms.labels(display=display).observe(ms)
+
+    def set_trace_open_spans(self, n: int) -> None:
+        if HAVE_PROM:
+            self.trace_open_spans.set(n)
+
+    def inc_trace_dropped(self, stage: str, n: int = 1) -> None:
+        if HAVE_PROM and n > 0:
+            self.trace_dropped.labels(stage=stage).inc(n)
 
     def inc_frames_dropped(self, n: int = 1) -> None:
         if HAVE_PROM and n > 0:
@@ -263,3 +372,78 @@ class Metrics:
         if not HAVE_PROM:  # pragma: no cover
             return b""
         return prom.generate_latest(self.registry)
+
+
+# ---------------------------------------------------------------------------
+# the observability HTTP endpoint
+
+
+def _make_observability_handler():
+    from http.server import BaseHTTPRequestHandler
+    from urllib.parse import parse_qs, urlparse
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "selkies-tpu-observability"
+
+        def _reply(self, code: int, body: bytes,
+                   ctype: str = "text/plain; charset=utf-8") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def log_message(self, fmt, *args):  # quiet: scrapes are periodic
+            logger.debug("http %s", fmt % args)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            m: Metrics = self.server.metrics
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            try:
+                if url.path == "/healthz":
+                    self._reply(200, b"ok\n")
+                elif url.path == "/metrics" or url.path == "/":
+                    self._reply(200, m.render() if m else b"",
+                                "text/plain; version=0.0.4; charset=utf-8")
+                elif url.path == "/debug/trace":
+                    rec = m.recorder if m else None
+                    if rec is None:
+                        self._reply(503, b"no flight recorder attached\n")
+                        return
+                    last_s = float(q.get("s", ["30"])[0])
+                    body = json.dumps(rec.export_trace_events(
+                        last_s=last_s)).encode()
+                    self._reply(200, body, "application/json")
+                elif url.path == "/debug/jax-trace":
+                    if not (m and m.jax_trace_enabled):
+                        self._reply(
+                            403, b"jax tracing disabled; set "
+                            b"jax_trace_enabled=true on the server\n")
+                        return
+                    import shutil
+
+                    from .tracing import capture_jax_trace
+
+                    ms = float(q.get("ms", ["500"])[0])
+                    # one fixed dir, pruned per capture: a polling
+                    # client must not accumulate profile dumps until
+                    # the disk fills (captures can be tens of MB)
+                    out_dir = os.path.join(tempfile.gettempdir(),
+                                           "selkies_jax_trace")
+                    shutil.rmtree(out_dir, ignore_errors=True)
+                    os.makedirs(out_dir, exist_ok=True)
+                    info = capture_jax_trace(out_dir, ms)
+                    self._reply(200, json.dumps(info).encode(),
+                                "application/json")
+                else:
+                    self._reply(404, b"not found\n")
+            except Exception as e:
+                logger.exception("observability endpoint %s failed",
+                                 url.path)
+                self._reply(500, f"error: {e!r}\n".encode())
+
+    return Handler

@@ -56,6 +56,15 @@ class MeshSessionFacade:
     def force_keyframe(self) -> None:
         self._coord._force_keyframe(self.slot)
 
+    def pop_trace(self, seq: int):
+        """Flight-recorder stage intervals for a harvested frame.
+
+        Mesh attribution is coarser than the solo pipelines: the sharded
+        harvest interleaves the D2H fetch with host assembly, so the
+        whole harvest wall rides ``fetch_wait`` and there is no separate
+        ``pack`` interval (docs/observability.md, stage glossary)."""
+        return self._coord._pop_trace(self.slot, seq)
+
     def close(self) -> None:
         if not self.closed:
             self.closed = True
@@ -123,6 +132,9 @@ class MeshEncodeCoordinator:
         self._pending: Dict[int, Any] = {}       # slot -> newest frame
         self._results: Dict[int, List] = {}      # slot -> [(seq, stripes)]
         self._seq: Dict[int, int] = {}
+        #: slot -> {seq: stage intervals} for the flight recorder,
+        #: bounded per slot; popped by the facade alongside _poll results
+        self._traces: Dict[int, Dict[int, dict]] = {}
         self._want_key: set = set()
         self._want_reset: set = set()
         #: bounded in-flight window (ISSUE 12): up to ``max_inflight``
@@ -173,6 +185,7 @@ class MeshEncodeCoordinator:
             self._gen[slot] += 1
             self._attached[slot] = True
             self._results[slot] = []
+            self._traces[slot] = {}
             self._seq[slot] = 0
             # applied at tick time: the worker may be mid-dispatch and the
             # encoder's host state is not safe to touch from here. A new
@@ -187,7 +200,12 @@ class MeshEncodeCoordinator:
             self._attached.pop(slot, None)
             self._pending.pop(slot, None)
             self._results.pop(slot, None)
+            self._traces.pop(slot, None)
             self._free.append(slot)
+
+    def _pop_trace(self, slot: int, seq: int):
+        with self._lock:
+            return self._traces.get(slot, {}).pop(seq, None)
 
     def stop(self) -> None:
         self._stop.set()
@@ -204,7 +222,15 @@ class MeshEncodeCoordinator:
                 return None
             dropped = slot in self._pending
             self._pending[slot] = frame
-            seq = self._seq[slot]
+            # the seq THIS frame will harvest under: _seq advances only
+            # at harvest, so frames of this slot already in the in-flight
+            # window (same generation) come first — without the offset,
+            # overlapped steady state would hand the in-flight frame's
+            # seq to every new submit (trace correlation off by one)
+            gen = self._gen[slot]
+            inflight = sum(1 for entry in self._inflight_q
+                           for s, g in entry[1] if s == slot and g == gen)
+            seq = self._seq[slot] + inflight
         self._kick.set()
         return None if dropped else seq
 
@@ -286,7 +312,7 @@ class MeshEncodeCoordinator:
 
     def _recompute_inflight_slots_locked(self) -> None:
         self._inflight_slots = {
-            s for _, took in self._inflight_q for s, _ in took}
+            s for entry in self._inflight_q for s, _ in entry[1]}
 
     def _fetch_ready(self, pending) -> bool:
         ready = getattr(self.enc, "fetch_ready", None)
@@ -300,7 +326,8 @@ class MeshEncodeCoordinator:
     def _harvest_oldest(self) -> None:
         """Harvest the head of the in-flight window (dispatch order is
         mandatory: per-stripe host state advances per tick)."""
-        pending, took = self._inflight_q[0]
+        pending, took, dispatch_iv = self._inflight_q[0]
+        t0 = time.monotonic()
         try:
             out, session_bytes = self.enc.harvest(pending)
         except Exception:
@@ -310,6 +337,11 @@ class MeshEncodeCoordinator:
                     self.slot_errors[slot] += 1
                 self._recompute_inflight_slots_locked()
             raise
+        # flight-recorder intervals: the sharded harvest interleaves the
+        # D2H materialization with host assembly, so the whole wall is
+        # attributed to fetch_wait (coarser than the solo pipelines; the
+        # stage glossary in docs/observability.md documents this)
+        harvest_iv = (t0, time.monotonic())
         with self._lock:
             self._inflight_q.popleft()
             self._recompute_inflight_slots_locked()
@@ -320,6 +352,11 @@ class MeshEncodeCoordinator:
                 seq = self._seq[slot]
                 self._seq[slot] = seq + 1
                 self._results[slot].append((seq, out[slot]))
+                traces = self._traces.setdefault(slot, {})
+                traces[seq] = {"dispatch": dispatch_iv,
+                               "fetch_wait": harvest_iv}
+                while len(traces) > 32:
+                    traces.pop(next(iter(traces)))
 
     def _tick(self) -> None:
         """Dispatch this tick's frames, then drain the in-flight window:
@@ -349,6 +386,7 @@ class MeshEncodeCoordinator:
         # fetch BEFORE the new dispatch, never after
         while took and len(self._inflight_q) >= self.max_inflight:
             self._harvest_oldest()
+        t_disp0 = time.monotonic()
         try:
             pending = self.enc.dispatch(frames) if took else None
         except Exception:
@@ -362,7 +400,8 @@ class MeshEncodeCoordinator:
             raise
         if pending is not None:
             with self._lock:
-                self._inflight_q.append((pending, took))
+                self._inflight_q.append(
+                    (pending, took, (t_disp0, time.monotonic())))
                 self.inflight_batches_max = max(self.inflight_batches_max,
                                                 len(self._inflight_q))
         # opportunistic drain: only fetches that already landed are

@@ -246,6 +246,10 @@ class BoundedSendQueue:
         self.video_len = 0
         self.dropped_video_total = 0
         self.overflow_since: Optional[float] = None
+        #: optional hook called with each video message discarded by the
+        #: drop-oldest policy — the flight recorder closes a dropped
+        #: frame's span through it (never raises into the offer path)
+        self.on_drop: Optional[Callable[[object], None]] = None
 
     def __len__(self) -> int:
         return len(self._q)
@@ -258,7 +262,7 @@ class BoundedSendQueue:
             return True
         dropped = False
         if self.video_len >= self.max_video:
-            for i, (_, ctl) in enumerate(self._q):
+            for i, (msg, ctl) in enumerate(self._q):
                 if not ctl:
                     del self._q[i]
                     self.video_len -= 1
@@ -266,6 +270,11 @@ class BoundedSendQueue:
                     dropped = True
                     if self.overflow_since is None:
                         self.overflow_since = self._clock()
+                    if self.on_drop is not None:
+                        try:
+                            self.on_drop(msg)
+                        except Exception:
+                            pass
                     break
         self._q.append((message, False))
         self.video_len += 1

@@ -20,6 +20,7 @@ import json
 import logging
 import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Set, Tuple
 
@@ -33,6 +34,7 @@ from ..protocol.wire import (
     parse_text_message,
     unpack_client_binary,
 )
+from ..observability.tracing import FlightRecorder
 from ..robustness import (
     FAILED,
     UPLOAD_VERB_COST,
@@ -90,6 +92,23 @@ def _ws_broadcast(targets, message) -> None:
         websockets.broadcast(real, message)
 
 
+class _TracedChunk:
+    """A media chunk carrying its frame's flight-recorder trace through
+    the owner's send queue: only the LAST stripe of a frame rides traced
+    (the frame is decodable when that stripe lands), so queue/send/ack
+    measure the whole frame without N-stripe double counting."""
+
+    __slots__ = ("payload", "trace", "t_offer")
+
+    def __init__(self, payload, trace, t_offer: float) -> None:
+        self.payload = payload
+        self.trace = trace
+        self.t_offer = t_offer
+
+    def __len__(self) -> int:       # byte accounting parity with bytes
+        return len(self.payload)
+
+
 class _ClientSendQueue:
     """Asyncio drainer around a :class:`BoundedSendQueue` for one client.
 
@@ -97,15 +116,29 @@ class _ClientSendQueue:
     blocks the capture loop); this drainer task awaits the transport's
     real ``send`` so per-client flow control backs up into the queue —
     where drop-oldest-video and the eviction verdict live — instead of
-    into the shared event loop."""
+    into the shared event loop.
 
-    def __init__(self, ws, q: BoundedSendQueue, on_evict) -> None:
+    Flight-recorder duty (ISSUE 13): a :class:`_TracedChunk` passing
+    through here closes the frame's ``queue`` and ``send`` stages and
+    registers the span for ACK correlation; every way a traced chunk can
+    die (drop-oldest overflow, a raising transport send, queue teardown)
+    lands a terminal ``dropped@`` mark instead of leaking the span."""
+
+    def __init__(self, ws, q: BoundedSendQueue, on_evict,
+                 recorder: Optional[FlightRecorder] = None) -> None:
         self.ws = ws
         self.q = q
         self.evicted = False
         self._on_evict = on_evict
+        self._recorder = recorder
+        # drop-oldest may discard a traced chunk: its span must close
+        q.on_drop = self._on_video_dropped
         self._wake = asyncio.Event()
         self.task = asyncio.create_task(self._drain())
+
+    def _on_video_dropped(self, message) -> None:
+        if isinstance(message, _TracedChunk) and self._recorder is not None:
+            self._recorder.drop(message.trace, "queue")
 
     def offer(self, message, control: bool) -> None:
         self.q.offer(message, control=control)
@@ -113,6 +146,38 @@ class _ClientSendQueue:
         if not self.evicted and self.q.should_evict:
             self.evicted = True
             self._on_evict(self)
+
+    def offer_traced(self, payload, trace) -> None:
+        """Queue the frame's last stripe with its trace attached (the
+        queue stage opens now; the drainer closes it at pop time)."""
+        self.offer(_TracedChunk(payload, trace, time.monotonic()),
+                   control=False)
+
+    async def _send_one(self, message) -> None:
+        if not isinstance(message, _TracedChunk):
+            await self.ws.send(message)
+            return
+        tr = message.trace
+        now = time.monotonic()
+        tr.mark("queue", message.t_offer, now)
+        # register for ACK correlation BEFORE the await: under write
+        # backpressure the payload can reach the client (and its ACK the
+        # reader task) while this coroutine is still suspended in send —
+        # exactly the frames glass_to_glass_ms exists to observe. An ack
+        # racing the send closes the span from the queue-exit mark; the
+        # RTT then includes the transport write, which is honest.
+        if self._recorder is not None:
+            self._recorder.sent(tr)
+        try:
+            await self.ws.send(message.payload)
+        except BaseException:
+            # transport death / cancellation mid-send: terminal mark,
+            # then let the existing error handling decide the session
+            if self._recorder is not None and tr.terminal is None:
+                self._recorder.drop(tr, "send")
+            raise
+        if tr.terminal is None:
+            tr.mark("send", now, time.monotonic())
 
     async def _drain(self) -> None:
         try:
@@ -123,7 +188,7 @@ class _ClientSendQueue:
                     message = self.q.pop()
                     if message is None:
                         break
-                    await self.ws.send(message)
+                    await self._send_one(message)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -134,6 +199,12 @@ class _ClientSendQueue:
     def close(self) -> None:
         if self.task is not None and not self.task.done():
             self.task.cancel()
+        # spans queued behind the cancellation point must still close
+        while True:
+            message = self.q.pop()
+            if message is None:
+                break
+            self._on_video_dropped(message)
 
 
 def upload_dir() -> str:
@@ -341,6 +412,12 @@ class DataStreamingServer:
         #: checked at the real capture/encode/fetch/ws call sites
         self.faults = FaultInjector(str(getattr(settings, "tpu_faults", "")
                                         or ""))
+        #: frame flight recorder (ISSUE 13, docs/observability.md): every
+        #: served frame's capture→ack stage timeline, exported via the
+        #: metrics endpoint (/debug/trace), the system_health feed, and
+        #: the per-stage Prometheus histograms. Always on — marking a
+        #: trace is a few dict stores per frame.
+        self.recorder = FlightRecorder(capacity=4096)
         #: fire-and-forget helpers (ws.drop closes, failed-display
         #: teardown) — referenced so they are neither GC'd mid-flight nor
         #: left to warn "exception was never retrieved"
@@ -561,7 +638,8 @@ class DataStreamingServer:
                     max_video=int(self.settings.max_send_queue),
                     evict_after_s=float(int(
                         self.settings.slow_client_evict_s))),
-                on_evict=self._evict_slow_client)
+                on_evict=self._evict_slow_client,
+                recorder=self.recorder)
             if self._stats_task is None or self._stats_task.done():
                 self._stats_task = asyncio.create_task(self._stats_loop())
             async for message in websocket:
@@ -675,9 +753,14 @@ class DataStreamingServer:
             st = self._display_of(websocket)
             if st and st.ws is websocket and msg.args:
                 try:
-                    st.bp.on_client_ack(int(msg.args[0]))
+                    fid = int(msg.args[0])
                 except ValueError:
                     pass
+                else:
+                    st.bp.on_client_ack(fid)
+                    # the ACK closes the frame's flight span with the
+                    # true network round trip (send end → ack arrival)
+                    self.recorder.ack(st.display_id, fid)
         elif verb == "r" and len(msg.args) >= 1:
             await self._on_resize(websocket, msg.args)
         elif verb == "START_VIDEO":
@@ -1034,6 +1117,9 @@ class DataStreamingServer:
 
     async def _reset_frame_ids_and_notify(self, st: DisplayState) -> None:
         st.bp.reset()
+        # ids restart at 1: frames sent under the old numbering will
+        # never be ACKed — close their spans instead of leaking them
+        self.recorder.drop_awaiting(st.display_id, "reset")
         message = f"PIPELINE_RESETTING {st.display_id}"
         if st.display_id == "primary":
             self.broadcast(message)
@@ -1119,6 +1205,8 @@ class DataStreamingServer:
             setattr(st, attr, None)
         st.supervisor = None
         st.bp_supervisor = None
+        # a stopped display's un-ACKed frames will never resolve
+        self.recorder.drop_awaiting(st.display_id, "stop")
         encoder, st.encoder = st.encoder, None
         if encoder is not None:
             close = getattr(encoder, "close", None)
@@ -1185,6 +1273,13 @@ class DataStreamingServer:
             encoder.faults = faults
         st.encoder = encoder
         source = None
+        recorder = self.recorder
+        #: flight-recorder spans for frames submitted but not yet
+        #: harvested, keyed by the encoder's submit seq; encoders whose
+        #: submit() returns no seq correlate FIFO (results arrive in
+        #: submission order on every adapter)
+        pending_tr: Dict[int, Any] = {}
+        pending_fifo: deque = deque()
         try:
             if sup is not None:
                 sup.beat()   # encoder construction counts as progress
@@ -1225,22 +1320,55 @@ class DataStreamingServer:
                 progressed = False
                 accepted = True     # "no submit attempted" is not a wedge
                 if st.bp.send_enabled:
+                    t_cap0 = time.monotonic()
                     frame = source.next_frame()
+                    t_cap1 = time.monotonic()
                     if frame is not None:
+                        # open this frame's flight span: (display, frame)
+                        # context threaded capture → ... → client ACK
+                        tr = recorder.begin(st.display_id, t=t_cap0)
+                        tr.mark("capture", t_cap0, t_cap1)
                         # never block the shared event loop: drop when full
                         try_submit = getattr(encoder, "try_submit", None)
+                        seq = None
                         try:
                             faults.maybe_raise("encode.raise")
                             if try_submit is not None:
                                 # None = dropped (pipeline full): fine in
                                 # bursts, but sustained non-acceptance with
                                 # no harvests below means a wedged pipeline
-                                accepted = try_submit(frame) is not None
+                                seq = try_submit(frame)
+                                accepted = seq is not None
                             else:
-                                encoder.submit(frame)
+                                seq = encoder.submit(frame)
                         except Exception as e:
+                            recorder.drop(tr, "submit")
                             raise EncoderFault(
                                 f"encoder submit failed: {e!r}") from e
+                        if not accepted:
+                            # backpressure at the edge: a dropped frame
+                            # closes terminally, it never leaks a span
+                            recorder.drop(tr, "submit")
+                        elif seq is not None:
+                            # seq reuse (the mesh facade re-numbers only
+                            # at harvest): the superseded frame's span
+                            # must close, not silently vanish
+                            old = pending_tr.get(seq)
+                            if old is not None:
+                                recorder.drop(old, "submit")
+                            pending_tr[seq] = tr
+                            # hard bound: a pipeline accepting submits
+                            # but never harvesting must not grow this
+                            # map until the watchdog fires
+                            while len(pending_tr) > 512:
+                                oldest = next(iter(pending_tr))
+                                recorder.drop(pending_tr.pop(oldest),
+                                              "submit")
+                        else:
+                            pending_fifo.append(tr)
+                            while len(pending_fifo) > 512:
+                                recorder.drop(pending_fifo.popleft(),
+                                              "submit")
                         progressed = True
                 await faults.maybe_hang("fetch.hang")
                 try:
@@ -1254,16 +1382,36 @@ class DataStreamingServer:
                     # them keeps that from reading as a stall
                     sup.beat()
                 for _seq, stripes in harvested:
+                    tr = pending_tr.pop(_seq, None)
+                    if tr is None and pending_fifo:
+                        tr = pending_fifo.popleft()
+                    if tr is not None:
+                        # fold in the encoder-side stage intervals
+                        # (stage/dispatch/fetch_wait/pack) harvested
+                        # with the frame
+                        pop_trace = getattr(encoder, "pop_trace", None)
+                        if pop_trace is not None:
+                            try:
+                                tr.merge(pop_trace(_seq))
+                            except Exception:
+                                logger.debug("pop_trace failed",
+                                             exc_info=True)
                     if not stripes:
+                        # damage gating emitted nothing: a coalesced
+                        # frame, closed (not dropped, not acked)
+                        if tr is not None:
+                            recorder.finish_empty(tr)
                         continue
                     progressed = True
                     frame_id = FrameId.next(frame_id)
                     viewers = self._viewers_of(st.display_id)
-                    for s in stripes:
-                        chunk = self._pack_stripe(frame_id, s, encoder)
-                        if viewers:
-                            self._fanout(viewers, chunk)
-                            self.bytes_sent += len(chunk) * len(viewers)
+                    try:
+                        self._emit_frame(st, encoder, frame_id, stripes,
+                                         viewers, tr)
+                    except BaseException:
+                        if tr is not None and tr.terminal is None:
+                            recorder.drop(tr, "send")
+                        raise
                     st.bp.on_frame_sent(frame_id)
                 if any(stripes for _seq, stripes in harvested):
                     accepted = True
@@ -1317,6 +1465,14 @@ class DataStreamingServer:
                 except Exception:
                     logger.exception("source stop for %s raised",
                                      st.display_id)
+            # frames in flight inside the (about to be closed) encoder
+            # are abandoned with it: close their spans terminally so a
+            # supervised restart never leaks open spans
+            for tr in pending_tr.values():
+                recorder.drop(tr, "restart")
+            pending_tr.clear()
+            while pending_fifo:
+                recorder.drop(pending_fifo.popleft(), "restart")
             st.encoder = None
             close = getattr(encoder, "close", None)
             if close is not None:
@@ -1325,6 +1481,58 @@ class DataStreamingServer:
                 except Exception:
                     logger.exception("encoder close for %s raised",
                                      st.display_id)
+
+    def _emit_frame(self, st: DisplayState, encoder, frame_id: int,
+                    stripes, viewers, tr) -> None:
+        """Wire-pack and fan out one harvested frame.
+
+        Flight recorder: the LAST stripe of a traced frame rides the
+        owner's send queue with the trace attached (the frame is
+        decodable when that stripe lands), closing queue/send there and
+        registering the span for CLIENT_FRAME_ACK correlation; every
+        no-delivery path (no viewers, evicted owner, ownerless display)
+        closes the span terminally instead of leaking it."""
+        recorder = self.recorder
+        owner = st.ws
+        owner_cq = self._send_queues.get(owner) if owner is not None else None
+        if tr is not None:
+            tr.frame_id = frame_id
+        n = len(stripes)
+        for i, s in enumerate(stripes):
+            chunk = self._pack_stripe(frame_id, s, encoder)
+            if not viewers:
+                continue
+            traced_here = (tr is not None and i == n - 1
+                           and owner is not None and owner in viewers)
+            if traced_here:
+                others = viewers - {owner}
+                if others:
+                    self._fanout(others, chunk)
+                if owner_cq is not None and not owner_cq.evicted:
+                    owner_cq.offer_traced(chunk, tr)
+                elif owner_cq is not None:
+                    # evicted mid-kill: the frame will never reach the
+                    # owner, so its span ends at the queue
+                    recorder.drop(tr, "queue")
+                else:
+                    # no send queue (client registered outside
+                    # ws_handler): direct synchronous fan-out — queue
+                    # dwell is zero by construction
+                    t0 = time.monotonic()
+                    _ws_broadcast({owner}, chunk)
+                    t1 = time.monotonic()
+                    tr.mark("queue", t0, t0)
+                    tr.mark("send", t0, t1)
+                    recorder.sent(tr)
+            else:
+                self._fanout(viewers, chunk)
+            self.bytes_sent += len(chunk) * len(viewers)
+        if tr is not None and tr.terminal is None and not (
+                viewers and owner is not None and owner in viewers):
+            # encoded, but nobody to ack it (no clients / viewer-only
+            # fan-out): close terminally rather than waiting on an ACK
+            # that cannot come
+            recorder.drop(tr, "send")
 
     @staticmethod
     def _pack_stripe(frame_id: int, s, encoder) -> bytes:
@@ -1510,6 +1718,20 @@ class DataStreamingServer:
                     est = {}
                 d["frames_dropped"] = est.get("frames_dropped", 0)
                 d["encode_errors"] = est.get("encode_errors", 0)
+            # flight-recorder stage breakdown (ISSUE 13): where each
+            # frame's time went, pushed so the client stats overlay can
+            # show it without scraping Prometheus
+            try:
+                summ = self.recorder.summary(did, last_s=60.0)
+            except Exception:
+                summ = {}
+            if summ.get("stages"):
+                d["stages"] = {
+                    stage: {"p50_ms": v["p50_ms"], "p95_ms": v["p95_ms"]}
+                    for stage, v in summ["stages"].items()}
+                for k in ("glass_to_glass_p50_ms", "encode_only_p50_ms"):
+                    if k in summ:
+                        d[k] = summ[k]
             displays[did] = d
         return pack_system_health(displays)
 
@@ -1641,6 +1863,14 @@ class DataStreamingServer:
             await asyncio.sleep(STATS_INTERVAL_S)
             try:
                 self._update_load_shed()
+                # flight-recorder upkeep: late metrics attachment and the
+                # expiry sweep (clients that never ACK must not pin open
+                # spans forever)
+                self.recorder.metrics = self.metrics
+                self.recorder.expire()
+                if self.metrics is not None:
+                    self.metrics.set_trace_open_spans(
+                        self.recorder.open_spans())
                 if self.metrics is not None:
                     # aggregated ONCE per tick here, not per display loop
                     self.metrics.set_backpressured(sum(

@@ -152,8 +152,15 @@ async def _amain(settings: Settings) -> int:
 
         if int(settings.metrics_port) > 0:
             metrics = Metrics(port=int(settings.metrics_port))
+            # observability surface (docs/observability.md): the flight
+            # recorder backs /debug/trace; the jax.profiler hook is
+            # opt-in. start_http is non-fatal on a busy port.
+            metrics.recorder = server.recorder
+            metrics.jax_trace_enabled = bool(
+                settings.jax_trace_enabled.value)
             metrics.start_http()
             server.metrics = metrics
+            server.recorder.metrics = metrics
     except Exception as e:
         logging.getLogger("selkies_tpu").warning("metrics disabled: %s", e)
 

@@ -307,7 +307,13 @@ SETTING_DEFINITIONS: List[Spec] = [
     IntSpec("web_port", 8080, "HTTP port for the web client + signaling "
             "(reference signalling_web.py default).", server_only=True),
     IntSpec("metrics_port", 8000, "Prometheus metrics port (0 disables; "
-            "reference legacy/metrics.py default).", server_only=True),
+            "reference legacy/metrics.py default). Also serves /healthz, "
+            "/debug/trace, and (opt-in) /debug/jax-trace "
+            "(docs/observability.md).", server_only=True),
+    BoolSpec("jax_trace_enabled", False, "Allow on-demand jax.profiler "
+             "captures via /debug/jax-trace on the metrics port "
+             "(writes profile files to a temp dir; off by default).",
+             server_only=True),
     StrSpec("turn_host", "", "TURN server hostname for /turn credentials.",
             legacy_env="TURN_HOST", server_only=True),
     StrSpec("turn_port", "3478", "TURN server port.",

@@ -1,0 +1,27 @@
+"""Metric/doc drift gate (ISSUE 13 satellite): every Prometheus series
+registered in observability/metrics.py must be documented in
+docs/observability.md and vice versa — silent metric drift fails
+tier-1, not a quarterly docs audit."""
+
+from tools.metrics_lint import check, code_series, doc_series
+
+
+def test_no_metric_doc_drift():
+    undocumented, stale = check()
+    assert not undocumented, (
+        f"series registered in metrics.py but missing from "
+        f"docs/observability.md: {sorted(undocumented)}")
+    assert not stale, (
+        f"series documented in docs/observability.md but not registered "
+        f"in metrics.py: {sorted(stale)}")
+
+
+def test_lint_actually_parses_both_sides():
+    # a regression that parses zero names on either side would make the
+    # drift check vacuously green — pin a floor and known members
+    code = code_series()
+    docs = doc_series()
+    assert len(code) >= 30
+    assert "frame_stage_ms" in code
+    assert "glass_to_glass_ms" in docs
+    assert "fps" in code and "fps" in docs

@@ -434,6 +434,7 @@ def test_mesh_tick_failure_attributes_slots_and_unblocks_flush():
     coord._attached = {0: True, 1: True}
     coord._pending = {0: "frame0", 1: "frame1"}
     coord._results = {0: [], 1: []}
+    coord._traces = {0: {}, 1: {}}
     coord._seq = {0: 0, 1: 0}
     coord._want_key = set()
     coord._want_reset = set()

@@ -520,6 +520,22 @@ def test_dashboard_stats_render(dash_env):
     assert "77%" in stats_el.textContent
 
 
+def test_dashboard_stage_breakdown_render(dash_env):
+    # ISSUE 13: the flight-recorder stage block riding system_health is
+    # rendered in the stats overlay (where each frame's time went)
+    dash, root, canvas, ws = make_dashboard(dash_env)
+    ws.server_text(SCHEMA)
+    ws.server_text(
+        '{"type": "system_health", "displays": {"primary": {'
+        '"rung": "device", "glass_to_glass_p50_ms": 42.5,'
+        ' "stages": {"capture": {"p50_ms": 1.3, "p95_ms": 3.0},'
+        ' "ack": {"p50_ms": 12.0, "p95_ms": 30.0}}}}}')
+    stats_el = dash_env.get(dash, "statsEl")
+    assert "g2g 42.5 ms" in stats_el.textContent
+    assert "capture 1.3" in stats_el.textContent
+    assert "ack 12.0" in stats_el.textContent
+
+
 def test_dashboard_sharing_links_and_copy(dash_env):
     dash, root, canvas, ws = make_dashboard(dash_env)
     dash_env.clipboard_writes.clear()

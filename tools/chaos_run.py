@@ -232,8 +232,19 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
             "rate_limited": dict(server.edge_stats["rate_limited"]),
             "slow_client_evictions":
                 server.edge_stats["slow_client_evictions"],
-            "alive": recovered and server._failed_displays() == 0,
         }
+        # flight-recorder leak invariant (ISSUE 13): after teardown,
+        # EVERY span opened during the fault storm must have reached a
+        # terminal mark — dropped frames included. A nonzero residue
+        # here is a span leak, and the run fails on it.
+        await reap(ws, task)
+        await server.stop()
+        report["trace_open_spans"] = server.recorder.open_spans()
+        report["frames_traced"] = server.recorder.closed_total
+        report["trace_dropped"] = server.recorder.dropped_total
+        report["trace_acked"] = server.recorder.acked_total
+        report["alive"] = (recovered and server._failed_displays() == 0
+                          and report["trace_open_spans"] == 0)
         return report
     finally:
         await reap(ws, task)

@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Metrics/docs drift lint: every Prometheus series must be documented.
+
+Cross-checks the series registered in
+``selkies_tpu/observability/metrics.py`` against the metrics reference
+table in ``docs/observability.md`` — in BOTH directions. A series added
+to the code without documentation (or documented but deleted from the
+code) fails tier-1 (tests/test_metrics_lint.py), so the operator-facing
+reference can never silently drift from what the server actually
+exports.
+
+Conventions checked:
+* code side: the first string literal of every ``Gauge(`` / ``Counter(``
+  / ``Histogram(`` / ``Info(`` construction (the registered name, as
+  written — counters keep their explicit ``_total`` suffix, Info keeps
+  its base name);
+* docs side: every backtick-quoted token in table rows of the
+  "Metrics reference" section of docs/observability.md whose cell
+  starts the row (``| `name` | ... |``).
+
+Usage::
+
+    python tools/metrics_lint.py          # prints drift, exit 1 on any
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Set, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+METRICS_PY = os.path.join(ROOT, "selkies_tpu", "observability",
+                          "metrics.py")
+DOCS_MD = os.path.join(ROOT, "docs", "observability.md")
+
+_CODE_RE = re.compile(
+    r"\b(?:Gauge|Counter|Histogram|Info)\(\s*\n?\s*\"([a-zA-Z_:][a-zA-Z0-9_:]*)\"")
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`\s*\|")
+
+
+def code_series(path: str = METRICS_PY) -> Set[str]:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return set(_CODE_RE.findall(src))
+
+
+def doc_series(path: str = DOCS_MD) -> Set[str]:
+    names: Set[str] = set()
+    in_section = False
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            if line.startswith("#"):
+                in_section = "metrics reference" in line.lower()
+                continue
+            if not in_section:
+                continue
+            m = _DOC_ROW_RE.match(line.strip())
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def check() -> Tuple[Set[str], Set[str]]:
+    """Returns (registered but undocumented, documented but unregistered);
+    both empty == no drift."""
+    code = code_series()
+    docs = doc_series()
+    return code - docs, docs - code
+
+
+def main() -> int:
+    try:
+        undocumented, stale = check()
+    except FileNotFoundError as e:
+        print(f"metrics lint: missing input ({e})", file=sys.stderr)
+        return 2
+    ok = True
+    for name in sorted(undocumented):
+        print(f"UNDOCUMENTED: {name} is registered in metrics.py but "
+              f"missing from docs/observability.md")
+        ok = False
+    for name in sorted(stale):
+        print(f"STALE DOC: {name} is documented in docs/observability.md "
+              f"but not registered in metrics.py")
+        ok = False
+    if ok:
+        print(f"metrics lint ok: {len(code_series())} series documented")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

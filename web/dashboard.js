@@ -314,6 +314,19 @@ class SelkiesDashboard {
         this.stats.sent = (s.bytes_sent / 1e6).toFixed(1) + " MB";
       }
       if ("rtt_ms" in s) this.stats.rtt = s.rtt_ms + " ms";
+    } else if (s.type === "system_health") {
+      // flight-recorder stage breakdown: where each frame's time went
+      // (p50 ms per stage, pushed by the server's system_health feed)
+      for (const [id, d] of Object.entries(s.displays || {})) {
+        if (!d.stages) continue;
+        const parts = Object.entries(d.stages)
+          .map(([st, v]) => st + " " + v.p50_ms.toFixed(1));
+        let line = parts.join(" | ");
+        if ("glass_to_glass_p50_ms" in d) {
+          line = "g2g " + d.glass_to_glass_p50_ms + " ms | " + line;
+        }
+        this.stats["t:" + id] = line;
+      }
     }
     this._renderStats();
   }

@@ -198,7 +198,10 @@ class SelkiesClient {
         this.onServerSettings(body.settings || body);
       } else if (body.type === "stream_resolution") {
         this._applyResolution(body);
-      } else if (body.type && body.type.endsWith("_stats")) {
+      } else if (body.type && (body.type.endsWith("_stats") ||
+                               body.type === "system_health")) {
+        // system_health carries the flight-recorder stage breakdown
+        // (where each frame's time went) alongside supervision state
         this.onStats(body);
       }
     }
