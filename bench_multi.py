@@ -10,6 +10,12 @@ available devices, two ways:
      multi-chip slice sessions are data-parallel over the "session" mesh
      axis; on one chip the batch amortizes per-dispatch overhead.
 
+Plus the scaling-story series (ISSUE 14): a short swarm churn storm
+(tools/swarm_run.py, device-free scheduler path) contributes
+``sessions_per_chip``, ``fairness_jain_index``, and ``eviction_ms_p95``
+so MULTICHIP_*.json tracks multi-tenant packing across PRs, not only raw
+encoder throughput.
+
 Prints ONE JSON line with the better aggregate as the headline value and
 both breakdowns.
 """
@@ -138,6 +144,37 @@ def bench_mesh() -> dict:
     }
 
 
+def bench_swarm() -> dict:
+    """Scheduler-plane churn metrics (docs/scaling.md): a bounded swarm
+    storm through the real ws_handler with device-free lanes — measures
+    packing, fairness, and eviction latency, not codec throughput (the
+    mesh/solo sections above own that)."""
+    import asyncio
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.swarm_run import swarm_run
+
+    try:
+        r = asyncio.run(swarm_run(
+            n_clients=64, duration_s=6.0, seed=0, concurrency=48,
+            slots_per_lane=8, max_lanes=4, encoder="fake",
+            sick_slot=True))
+    except Exception as e:
+        return {"swarm_error": repr(e)}
+    return {
+        "sessions_per_chip": r["sessions_per_chip"],
+        "fairness_jain_index": r["fairness_jain_index"],
+        "eviction_ms_p95": r["eviction_ms_p95"],
+        "swarm_clients": r["swarm_clients"],
+        "swarm_sessions_peak": r["sessions_peak"],
+        "swarm_frames": r["frames_delivered_total"],
+        "swarm_migrations": r["migrations"],
+        "swarm_leak_free": bool(r["alive"]),
+    }
+
+
 def main() -> None:
     import jax.numpy as jnp
 
@@ -214,6 +251,7 @@ def main() -> None:
         "elapsed_s": round(elapsed, 2),
         "mean_frame_kb": round(total_bytes / max(done, 1) / 1024, 1),
         **mesh,
+        **bench_swarm(),
     }))
 
 

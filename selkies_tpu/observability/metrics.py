@@ -167,6 +167,44 @@ class Metrics:
             "reconfigure_coalesced_total", "Resize/SETTINGS requests "
             "absorbed into an already-scheduled display reconfiguration",
             registry=self.registry)
+        self.sessions_queued = Counter(
+            "sessions_queued_total", "Display joins that waited in the "
+            "admission queue for a scheduler slot (admit-after-wait and "
+            "shed-after-wait both count)", registry=self.registry)
+        # ISSUE 14: session-scheduler health — the coordinator's per-slot
+        # fault domains were stats()-only before; a sick slot, a
+        # quarantine, or a live migration must be scrapeable
+        # (docs/scaling.md). Cumulative values are mirrored from the
+        # coordinator as gauges (the coordinator owns the counters).
+        self.mesh_active_sessions = Gauge(
+            "mesh_active_sessions", "Sessions attached to mesh scheduler "
+            "slots across all geometry buckets", registry=self.registry)
+        self.mesh_lanes = Gauge(
+            "mesh_lanes", "Live batch lanes across all geometry buckets "
+            "(each lane is one compiled SPMD encoder)",
+            registry=self.registry)
+        self.mesh_inflight_batches = Gauge(
+            "mesh_inflight_batches", "Mesh ticks dispatched but not yet "
+            "harvested, summed over lanes", registry=self.registry)
+        self.mesh_slot_errors = Gauge(
+            "mesh_slot_errors_total", "Frames lost to failed mesh "
+            "dispatch/harvest ticks, summed over slots (cumulative; "
+            "per-slot detail rides the system_health feed)",
+            registry=self.registry)
+        self.mesh_tick_errors = Gauge(
+            "mesh_tick_errors_total", "Failed mesh coordinator ticks "
+            "(cumulative, lane-contained failures included)",
+            registry=self.registry)
+        self.mesh_worker_restarts = Gauge(
+            "mesh_worker_restarts_total", "Mesh tick-thread re-spawns "
+            "after a worker death (cumulative)", registry=self.registry)
+        self.mesh_quarantined_slots = Gauge(
+            "mesh_quarantined_slots", "Scheduler slots removed from "
+            "service as sick fault domains", registry=self.registry)
+        self.mesh_migrations = Gauge(
+            "mesh_sessions_migrated_total", "Sessions live-migrated off "
+            "quarantined slots onto healthy lanes (cumulative)",
+            registry=self.registry)
         # ISSUE 13: flight-recorder stage series — the per-stage latency
         # decomposition behind the glass-to-glass number, labeled by
         # display so a sick session is attributable (docs/observability.md)
@@ -353,6 +391,26 @@ class Metrics:
     def inc_reconfigure_coalesced(self, n: int = 1) -> None:
         if HAVE_PROM and n > 0:
             self.reconfigure_coalesced.inc(n)
+
+    def inc_sessions_queued(self) -> None:
+        if HAVE_PROM:
+            self.sessions_queued.inc()
+
+    def set_mesh_health(self, *, active_sessions: int, lanes: int,
+                        inflight: int, slot_errors: int, tick_errors: int,
+                        worker_restarts: int, quarantined: int,
+                        migrations: int) -> None:
+        """Mirror the session scheduler's aggregate health (stats tick)."""
+        if not HAVE_PROM:
+            return
+        self.mesh_active_sessions.set(active_sessions)
+        self.mesh_lanes.set(lanes)
+        self.mesh_inflight_batches.set(inflight)
+        self.mesh_slot_errors.set(slot_errors)
+        self.mesh_tick_errors.set(tick_errors)
+        self.mesh_worker_restarts.set(worker_restarts)
+        self.mesh_quarantined_slots.set(quarantined)
+        self.mesh_migrations.set(migrations)
 
     def set_clients(self, n: int) -> None:
         if HAVE_PROM:

@@ -14,17 +14,6 @@ the stripe axis (a session's stripes live on different chips) and globally
 over the session axis to drive the shared rate controller.
 """
 
-from jax.sharding import Mesh
-
-from .mesh import (
-    BatchedSessionEncoder,
-    MeshStripeEncoder,
-    make_batched_entropy_step,
-    make_batched_step,
-    make_mesh,
-    parse_mesh_spec,
-)
-
 __all__ = [
     "Mesh",
     "make_mesh",
@@ -34,3 +23,23 @@ __all__ = [
     "BatchedSessionEncoder",
     "MeshStripeEncoder",
 ]
+
+#: lazily resolved (PEP 562) so the scheduler half of the package —
+#: `.coordinator` with an injected encoder factory, as used by the swarm
+#: harness and the scheduler tests — imports without initializing jax;
+#: device-touching names still resolve exactly as before on first use
+_MESH_EXPORTS = {
+    "BatchedSessionEncoder", "MeshStripeEncoder",
+    "make_batched_entropy_step", "make_batched_step", "make_mesh",
+    "parse_mesh_spec",
+}
+
+
+def __getattr__(name):
+    if name == "Mesh":
+        from jax.sharding import Mesh
+        return Mesh
+    if name in _MESH_EXPORTS:
+        from . import mesh
+        return getattr(mesh, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -166,20 +166,28 @@ def pack_full_frame(frame_id: int, annexb: bytes, is_key: bool) -> bytes:
     )
 
 
-def pack_system_health(displays: Dict[str, Dict]) -> str:
+def pack_system_health(displays: Dict[str, Dict],
+                       mesh: Dict[str, Dict] = None) -> str:
     """The ``system,health`` feed: per-display supervision state pushed to
     clients so degraded sessions are visible, not silent.
 
     ``displays`` maps display_id to a dict with at least ``rung`` (current
     degradation-ladder rung, see :data:`~selkies_tpu.robustness.RUNGS`),
-    ``supervisor`` (lifecycle state), and the restart counters. Rides the
-    same JSON channel as the stats feed; clients switch on ``type``.
+    ``supervisor`` (lifecycle state), and the restart counters. ``mesh``
+    (optional) maps geometry-bucket keys to the session scheduler's
+    lane/slot health snapshot (docs/scaling.md) — per-slot errors,
+    quarantines, and migrations, so a sick fault domain is visible from
+    the client overlay, not only from ``stats()``. Rides the same JSON
+    channel as the stats feed; clients switch on ``type``.
     """
-    return json.dumps({
+    payload = {
         "type": "system_health",
         "subsystem": "system,health",
         "displays": displays,
-    })
+    }
+    if mesh:
+        payload["mesh"] = mesh
+    return json.dumps(payload)
 
 
 def pack_audio_chunk(opus: bytes) -> bytes:

@@ -18,6 +18,12 @@ The wire-edge armor (docs/hardening.md) lives in :mod:`.ratelimit`:
 and error budgets, and :class:`BoundedSendQueue` slow-consumer
 isolation — pure clock-injected policy the server wires to real
 connections.
+
+The session scheduler's fault-domain policy (docs/scaling.md) lives in
+:mod:`.slot_health`: per-slot error EWMAs whose quarantine verdicts
+drive the mesh coordinator's live migration; :mod:`.testing` carries the
+device-free stand-ins (:class:`InProcessClient`,
+:class:`FakeMeshEncoder`) the chaos and swarm harnesses share.
 """
 
 from .faults import DEFAULT_HANG_S, POINTS, FaultInjected, FaultInjector
@@ -25,15 +31,17 @@ from .ladder import RUNGS, DegradationLadder, EncoderFault
 from .ratelimit import (DEFAULT_LIMITS, MESSAGE_CLASSES, UPLOAD_VERB_COST,
                         BoundedSendQueue, ConnectionGuard, TokenBucket,
                         classify_verb, parse_limit_spec)
+from .slot_health import SlotHealth
 from .supervisor import (BACKOFF, FAILED, IDLE, RUNNING, STOPPED, Supervisor,
                          backoff_delay)
-from .testing import InProcessClient
+from .testing import FakeMeshEncoder, FakeStripe, InProcessClient
 
 __all__ = [
     "BACKOFF", "BoundedSendQueue", "ConnectionGuard", "DEFAULT_HANG_S",
     "DEFAULT_LIMITS", "DegradationLadder", "EncoderFault", "FAILED",
-    "FaultInjected", "FaultInjector", "IDLE", "InProcessClient",
-    "MESSAGE_CLASSES", "POINTS", "RUNGS", "RUNNING", "STOPPED", "Supervisor",
-    "TokenBucket", "UPLOAD_VERB_COST", "backoff_delay", "classify_verb",
+    "FakeMeshEncoder", "FakeStripe", "FaultInjected", "FaultInjector",
+    "IDLE", "InProcessClient", "MESSAGE_CLASSES", "POINTS", "RUNGS",
+    "RUNNING", "STOPPED", "SlotHealth", "Supervisor", "TokenBucket",
+    "UPLOAD_VERB_COST", "backoff_delay", "classify_verb",
     "parse_limit_spec",
 ]

@@ -28,6 +28,13 @@ Fault points and their semantics at the call site:
                     steps the degradation ladder)
 ``fetch.hang``      the poll/fetch call site hangs — stalled D2H transfer
 ``ws.drop``         the display's websocket is closed mid-stream
+``mesh.tick_raise`` the mesh coordinator's whole tick raises (every lane
+                    skips this tick; the worker backs off and survives)
+``mesh.slot_raise`` ONE slot's dispatch is failed at frame-take time
+                    (arg: ``lane:slot`` or a bare slot index; empty =
+                    first checked slot) — the cohabiting sessions' tick
+                    proceeds, so chaos can prove slot faults never
+                    become mesh faults
 ==================  =======================================================
 
 A check on a disarmed point is a dict lookup — the production cost of the
@@ -52,6 +59,8 @@ POINTS = (
     "encode.raise",
     "fetch.hang",
     "ws.drop",
+    "mesh.tick_raise",
+    "mesh.slot_raise",
 )
 
 _ENTRY_RE = re.compile(
@@ -134,6 +143,31 @@ class FaultInjector:
         """Consume one firing of ``point`` if armed (decrements the count)."""
         arg_unused, fired = self._take(point)
         return fired
+
+    def should_fire_for(self, point: str, *keys) -> bool:
+        """Consume one firing only when the armed arg targets one of
+        ``keys`` (a call site may answer to several identities — e.g. a
+        mesh slot is both ``lane:slot`` and its bare slot index).
+
+        A keyed fault point (``mesh.slot_raise=0:3``) fires only at the
+        call site checking that key; an argless arming fires for the
+        first site checked. A non-matching check leaves the point armed —
+        it neither fires nor consumes."""
+        with self._lock:
+            entry = self._armed.get(point)
+            if entry is None:
+                return False
+            remaining, arg = entry
+            if arg is not None and str(arg) not in {str(k) for k in keys}:
+                return False
+            if remaining <= 1:
+                self._armed.pop(point, None)
+            else:
+                self._armed[point] = (remaining - 1, arg)
+            self.fired[point] = self.fired.get(point, 0) + 1
+        logger.warning("fault point fired: %s (keys=%s, #%d)", point, keys,
+                       self.fired[point])
+        return True
 
     def maybe_raise(self, point: str) -> None:
         """Raise :class:`FaultInjected` if ``point`` is armed."""

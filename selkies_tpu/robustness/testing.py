@@ -1,17 +1,25 @@
-"""In-process client stand-in for driving the data server without sockets.
+"""In-process stand-ins for driving the data server without sockets or jax.
 
 ``data_server._ws_broadcast`` duck-types on ``send_nowait``, and
 ``ws_handler`` only needs async ``send``/``close`` plus async iteration —
-so this one class is a full client as far as the server is concerned. It
-is the canonical fake for the fault-injection tier-1 tests
-(tests/test_robustness.py) and the chaos harness (tools/chaos_run.py);
-keeping it in one place keeps the duck-typed surface from silently
-diverging between the two.
+so :class:`InProcessClient` is a full client as far as the server is
+concerned. It is the canonical fake for the fault-injection tier-1 tests
+(tests/test_robustness.py), the chaos harness (tools/chaos_run.py), and
+the swarm churn harness (tools/swarm_run.py); keeping it in one place
+keeps the duck-typed surface from silently diverging between consumers.
+
+:class:`FakeMeshEncoder` is the device-free counterpart on the encoder
+side: it speaks the mesh encoder surface the coordinator drives
+(``dispatch``/``harvest``/``fetch_ready``/``reset_session``/
+``force_keyframe``), so scheduler behavior — dynamic lanes, slot health,
+quarantine/migration, churn — is testable at hundreds of sessions
+without compiling a single device program.
 """
 
 from __future__ import annotations
 
 import asyncio
+from dataclasses import dataclass
 from typing import List
 
 
@@ -66,3 +74,57 @@ class InProcessClient:
         if m is None:
             raise StopAsyncIteration
         return m
+
+
+# ---------------------------------------------------------------------------
+# mesh-encoder stand-in (scheduler tests / swarm harness)
+
+
+@dataclass
+class FakeStripe:
+    """Just enough stripe surface for the wire packer (no ``annexb``
+    attribute → packs as a JPEG stripe)."""
+
+    y_start: int = 0
+    height: int = 16
+    jpeg: bytes = b"\xff\xd8\xfa\x4b\x45\xff\xd9"
+    is_paintover: bool = False
+
+
+class FakeMeshEncoder:
+    """Mesh-encoder lookalike: one tiny stripe per submitted session.
+
+    ``fail_dispatches`` fails that many whole dispatch calls (a lane-level
+    fault); slot-scoped faults are injected upstream of dispatch via the
+    coordinator's ``mesh.slot_raise`` point, not here.
+    """
+
+    def __init__(self, n_sessions: int, width: int = 0, height: int = 0,
+                 fail_dispatches: int = 0) -> None:
+        self.n_sessions = int(n_sessions)
+        self.width, self.height = width, height
+        self.fail_dispatches = int(fail_dispatches)
+        self.dispatches = 0
+        self.resets: List[int] = []
+        self.keyframes: List[int] = []
+
+    def reset_session(self, session: int) -> None:
+        self.resets.append(session)
+
+    def force_keyframe(self, session: int) -> None:
+        self.keyframes.append(session)
+
+    def dispatch(self, frames):
+        if self.fail_dispatches > 0:
+            self.fail_dispatches -= 1
+            raise RuntimeError("injected mesh dispatch failure")
+        self.dispatches += 1
+        return [f is not None for f in frames]
+
+    def fetch_ready(self, pending) -> bool:
+        return True
+
+    def harvest(self, pending):
+        out = [[FakeStripe(height=16)] if took else [] for took in pending]
+        session_bytes = [len(s[0].jpeg) if s else 0 for s in out]
+        return out, session_bytes

@@ -61,6 +61,12 @@ UPLOAD_DIR_ENV = "SELKIES_UPLOAD_DIR"
 #: frame); 8192 covers 8K while keeping one frame under ~200 MB
 MAX_DISPLAY_DIM = 8192
 
+#: bounded mesh geometry-bucket count: each bucket's lanes hold device
+#: prev planes for all their slots. Joins past the cap are served by
+#: solo pipelines — the admission verdict and the acquire-time fallback
+#: must agree on this number, or verdicts shed clients solo could serve.
+MESH_BUCKET_CAP = 4
+
 
 def _clamp_dim(v: int) -> int:
     """Clamp a client-requested display dimension to [16, MAX] and even."""
@@ -355,6 +361,12 @@ class DisplayState:
     overrides: Dict[str, Any] = field(default_factory=dict)
     #: live encoder of the running capture loop (keyframe kicks)
     encoder: Any = None
+    #: (w, h, x, y) the running pipeline was started with — scoped
+    #: reconfiguration restarts only displays whose geometry changed
+    running_geom: Optional[Tuple[int, int, int, int]] = None
+    #: (overrides, framerate) snapshot at pipeline start: a SETTINGS
+    #: change with unchanged geometry must still rebuild the encoder
+    running_config: Optional[Tuple[Dict[str, Any], float]] = None
 
 
 @dataclass
@@ -400,6 +412,10 @@ class DataStreamingServer:
         #: mismatched-resolution join gets its own bucket instead of a
         #: silent solo fallback (VERDICT r2 item 6)
         self.mesh_coordinators: Dict[Tuple[int, int, str], Any] = {}
+        #: coordinator constructor override (tests / tools/swarm_run.py):
+        #: same signature as MeshEncodeCoordinator — lets harnesses run
+        #: the real scheduler over injected (device-free) encoders
+        self.coordinator_factory: Optional[Callable] = None
         #: geometries whose coordinator construction failed — scoped per
         #: geometry so one bad bucket (e.g. a transient OOM at 4K) does
         #: not disable mesh batching for healthy buckets
@@ -438,6 +454,7 @@ class DataStreamingServer:
             "rate_limited": {},
             "upload_paced": 0,
             "sessions_rejected": 0,
+            "sessions_queued": 0,
             "slow_client_evictions": 0,
             "reconfigure_runs": 0,
             "reconfigure_coalesced": 0,
@@ -602,6 +619,91 @@ class DataStreamingServer:
             pass
         return False
 
+    # -- display-plane admission: scheduler verdicts (docs/scaling.md) --
+
+    def _mesh_profile_of(self, overrides: Dict[str, Any]) -> str:
+        return str(overrides.get("encoder", self.settings.encoder))
+
+    def _display_admission_verdict(self, width: int, height: int,
+                                   overrides: Dict[str, Any]) -> str:
+        """``admit`` / ``queue`` / ``shed`` for a NEW display join.
+
+        The flat ``max_displays`` cap is the hard backstop; below it the
+        verdict comes from live lane capacity: a join whose geometry
+        bucket has a free or growable slot is admitted, a join into a
+        momentarily-full scheduler queues (leave/resize churn frees slots
+        within the queue window), and a genuinely full scheduler sheds.
+        Displays the mesh cannot serve (solo-only profiles, watermark,
+        failed geometries) are admitted toward their solo pipelines, and
+        ``mesh_overflow_solo`` restores the pre-scheduler overflow-to-solo
+        behavior wholesale."""
+        if self._load_shedding:
+            return "shed"
+        maxd = int(getattr(self.settings, "max_displays", 0) or 0)
+        if maxd and len(self.display_clients) >= maxd:
+            return "shed"
+        if not str(self.settings.tpu_mesh) or \
+                bool(getattr(self.settings, "mesh_overflow_solo", False)):
+            return "admit"
+        profile = self._mesh_profile_of(overrides)
+        if profile not in ("jpeg", "x264enc-striped") or \
+                str(self.settings.watermark_path):
+            return "admit"          # solo-served by design, not overflow
+        geom = (_clamp_dim(width), _clamp_dim(height), profile)
+        coord = self.mesh_coordinators.get(geom)
+        if coord is None:
+            if geom in self._mesh_failed_geoms:
+                return "admit"      # this geometry runs solo (scoped)
+            # below the bucket cap a fresh bucket can be built; past it
+            # the acquire path serves the join with a solo encoder by
+            # design — admit toward that, never queue on a condition
+            # that cannot resolve (buckets are not retired)
+            return "admit"
+        try:
+            cap = coord.capacity()
+        except Exception:
+            return "admit"
+        if cap["slots_free"] + cap["growable_slots"] > 0:
+            return "admit"
+        return "queue"
+
+    async def _await_display_admission(self, width: int, height: int,
+                                       overrides: Dict[str, Any]) -> str:
+        """Hold a queued join for up to ``admission_queue_ms`` waiting for
+        a scheduler slot to free (leave/resize churn), then resolve to
+        admit or shed. Bounded by construction — a queued client is never
+        parked forever."""
+        self.edge_stats["sessions_queued"] += 1
+        if self.metrics is not None:
+            self.metrics.inc_sessions_queued()
+        wait_ms = int(getattr(self.settings, "admission_queue_ms", 0) or 0)
+        deadline = time.monotonic() + wait_ms / 1000.0
+        while True:
+            verdict = self._display_admission_verdict(
+                width, height, overrides)
+            if verdict != "queue":
+                return verdict
+            if time.monotonic() >= deadline:
+                return "shed"
+            await asyncio.sleep(0.025)
+
+    def scheduler_stats(self) -> Optional[Dict[str, int]]:
+        """Aggregate live lane capacity across geometry buckets (None
+        when mesh batching is off) — the admission verdicts' input,
+        surfaced for the stats feed and harnesses."""
+        if not str(self.settings.tpu_mesh):
+            return None
+        agg = {"slots_free": 0, "growable_slots": 0, "slots_total": 0,
+               "quarantined_slots": 0, "active_sessions": 0, "lanes": 0}
+        for coord in self.mesh_coordinators.values():
+            try:
+                cap = coord.capacity()
+            except Exception:
+                continue
+            for k in agg:
+                agg[k] += int(cap.get(k, 0))
+        return agg
+
     async def ws_handler(self, websocket) -> None:
         if not await self._admit(websocket):
             return
@@ -698,8 +800,12 @@ class DataStreamingServer:
             dropped = False
             for st in list(self.display_clients.values()):
                 if st.ws is websocket:
-                    await self._stop_display(st)
+                    # deregister FIRST: a concurrent reconfigure worker
+                    # must see the display as gone before our stop lands,
+                    # or it can restart a zombie pipeline that holds its
+                    # scheduler slot forever (found by tools/swarm_run.py)
                     del self.display_clients[st.display_id]
+                    await self._stop_display(st)
                     dropped = True
             if dropped and self.display_clients:
                 # surviving displays reflow into a smaller framebuffer
@@ -969,20 +1075,41 @@ class DataStreamingServer:
             except Exception:
                 pass
         if st is None:
-            maxd = int(getattr(self.settings, "max_displays", 0) or 0)
-            if maxd and len(self.display_clients) >= maxd:
-                # admission control on the display plane: each display is
-                # a capture+encode pipeline, far heavier than a viewer
+            # admission control on the display plane (docs/scaling.md):
+            # each display is a capture+encode pipeline, far heavier than
+            # a viewer — the verdict comes from live scheduler lane
+            # capacity (admit / queue / shed), with max_displays as the
+            # hard backstop above it
+            verdict = self._display_admission_verdict(
+                width or 1024, height or 768, applied)
+            if verdict == "queue":
+                verdict = await self._await_display_admission(
+                    width or 1024, height or 768, applied)
+            if verdict != "admit":
                 self.edge_stats["sessions_rejected"] += 1
                 if self.metrics is not None:
                     self.metrics.inc_sessions_rejected()
-                logger.warning("display %s rejected: %d displays at cap",
-                               display_id, len(self.display_clients))
+                logger.warning(
+                    "display %s rejected (%s): %d displays live",
+                    display_id, verdict, len(self.display_clients))
                 await websocket.send("KILL server_full")
                 await websocket.close()
                 return
-            st = DisplayState(display_id=display_id)
-            self.display_clients[display_id] = st
+            # the queue wait yields the loop: another handshake may have
+            # registered this display meanwhile — adopt it (superseding
+            # its client, same as the pre-wait path), don't clobber
+            st = self.display_clients.get(display_id)
+            if st is not None and st.ws is not None \
+                    and st.ws is not websocket:
+                try:
+                    await st.ws.send(
+                        "KILL Display taken over by another client.")
+                    await st.ws.close()
+                except Exception:
+                    pass
+            if st is None:
+                st = DisplayState(display_id=display_id)
+                self.display_clients[display_id] = st
         st.ws = websocket
         if width is not None:
             st.width = width
@@ -1062,16 +1189,50 @@ class DataStreamingServer:
             logger.exception("display reconfiguration failed")
 
     async def _reconfigure_displays(self) -> None:
-        """Full display-plane reconfiguration (reference reconfigure_displays
-        selkies.py:2616): stop every capture, re-arrange the X screen, then
-        restart active pipelines with their new geometry/offsets.  Captures
-        stop FIRST so no XGetImage ever races a shrinking root window."""
-        for st in list(self.display_clients.values()):
-            await self._stop_display(st)
+        """Display-plane reconfiguration (reference reconfigure_displays
+        selkies.py:2616): stop captures, re-arrange the X screen, then
+        restart active pipelines with their new geometry/offsets.
+
+        With a real X server every capture stops FIRST so no XGetImage
+        ever races a shrinking root window. Without one (synthetic
+        capture: tests, the swarm churn harness) the restart is SCOPED to
+        displays whose geometry or offset actually changed — under
+        join/leave/resize churn at hundreds of sessions, a stop-the-world
+        restart per event would itself be the outage (docs/scaling.md)."""
+        scoped = True
+        try:
+            from ..display import xrandr_available
+
+            scoped = not xrandr_available()
+        except Exception:
+            pass
+        if not scoped:
+            for st in list(self.display_clients.values()):
+                await self._stop_display(st)
         await self._apply_x11_layout()
         for st in list(self.display_clients.values()):
-            if st.video_active and st.ws is not None:
-                await self._start_display(st)
+            if not (st.video_active and st.ws is not None):
+                continue
+            # running_geom/_config are what the live pipeline was STARTED
+            # with; st.width/height/overrides already carry the request.
+            # Offset-only shifts (every join reflows the framebuffer
+            # layout) don't restart in scoped mode: without xrandr there
+            # is no shared root window whose regions could go stale, and
+            # restarting N-1 healthy streams per join is the exact
+            # stop-the-world cost this path exists to avoid. A SETTINGS
+            # change (quality/framerate/encoder overrides) DOES restart —
+            # the encoder is built from that snapshot.
+            changed = (st.running_geom is None
+                       or st.running_geom[:2] != (st.width, st.height)
+                       or st.running_config != (st.overrides,
+                                                st.bp.framerate))
+            running = st.capture_task is not None \
+                and not st.capture_task.done()
+            if scoped and running and not changed:
+                continue        # untouched display keeps streaming
+            if scoped and running:
+                await self._stop_display(st)
+            await self._start_display(st)
 
     async def _apply_x11_layout(self) -> None:
         """Arrange the client displays into one framebuffer and mirror it
@@ -1151,6 +1312,12 @@ class DataStreamingServer:
             await self._stop_display_locked(st)
 
     async def _start_display_locked(self, st: DisplayState) -> None:
+        if self.display_clients.get(st.display_id) is not st:
+            # the display was deregistered (client disconnect) while a
+            # reconfigure/START_VIDEO raced toward this start: a pipeline
+            # started now would be a zombie nobody stops — leaked capture
+            # loop, leaked scheduler slot, leaked spans
+            return
         if st.capture_task and not st.capture_task.done():
             return
         # A failed/finished supervisor may leave a live backpressure task
@@ -1186,6 +1353,8 @@ class DataStreamingServer:
         )
         st.capture_task = asyncio.create_task(st.supervisor.run())
         st.backpressure_task = asyncio.create_task(st.bp_supervisor.run())
+        st.running_geom = (st.width, st.height, st.x, st.y)
+        st.running_config = (dict(st.overrides), st.bp.framerate)
 
     async def _stop_display_locked(self, st: DisplayState) -> None:
         """Exception-safe teardown: cancel BOTH tasks even if the first
@@ -1205,6 +1374,8 @@ class DataStreamingServer:
             setattr(st, attr, None)
         st.supervisor = None
         st.bp_supervisor = None
+        st.running_geom = None
+        st.running_config = None
         # a stopped display's un-ACKed frames will never resolve
         self.recorder.drop_awaiting(st.display_id, "stop")
         encoder, st.encoder = st.encoder, None
@@ -1307,11 +1478,27 @@ class DataStreamingServer:
             accepted_at = time.monotonic()
             logger.info("capture loop started for %s (%dx%d@%g, rung=%s)",
                         st.display_id, st.width, st.height, fps, rung)
+            consume_migration = getattr(encoder, "consume_migration", None)
             while True:
                 if sup is not None:
                     sup.beat()
                 faults.maybe_raise("capture.raise")
                 await faults.maybe_hang("capture.stall")
+                if consume_migration is not None and consume_migration():
+                    # the scheduler live-migrated this session off a
+                    # quarantined slot (docs/scaling.md): same recovery
+                    # grammar as a supervised restart — frame ids restart
+                    # with PIPELINE_RESETTING, the new slot's reset forces
+                    # a keyframe, and the restart budget is forgiven (the
+                    # scheduler absorbed the fault; the session is healthy)
+                    logger.warning("display %s migrated to a healthy "
+                                   "lane; resetting frame ids",
+                                   st.display_id)
+                    frame_id = 0
+                    await self._reset_frame_ids_and_notify(st)
+                    if sup is not None:
+                        sup.forgive()
+                    self._broadcast_health()
                 # clean-probe evidence for the ladder: the tick must have
                 # actually exercised the encoder (submit or delivery) AND
                 # harvested no new errors (on_error bumps failures_total
@@ -1577,9 +1764,7 @@ class DataStreamingServer:
             return None
         coord = self.mesh_coordinators.get(geom)
         if coord is None:
-            if len(self.mesh_coordinators) >= 4:
-                # bounded bucket count: each bucket holds device prev
-                # planes for all its slots
+            if len(self.mesh_coordinators) >= MESH_BUCKET_CAP:
                 self.mesh_stats["solo_fallback"] += 1
                 logger.warning(
                     "mesh batching: bucket limit reached; %s at %dx%d "
@@ -1588,15 +1773,21 @@ class DataStreamingServer:
             try:
                 from ..parallel.coordinator import MeshEncodeCoordinator
 
-                coord = MeshEncodeCoordinator(
+                factory = self.coordinator_factory or MeshEncodeCoordinator
+                coord = factory(
                     spec, int(self.settings.tpu_sessions_per_chip),
                     st.width, st.height, settings=self.settings,
                     framerate=fps, profile=profile)
+                # mesh fault points (mesh.tick_raise / mesh.slot_raise)
+                # check the server's injector at the coordinator's sites
+                coord.faults = self.faults
                 self.mesh_coordinators[geom] = coord
                 logger.info(
-                    "mesh batching: %s → %d %s session slots at %dx%d "
-                    "(bucket %d)", spec, coord.n_sessions, profile,
-                    st.width, st.height, len(self.mesh_coordinators))
+                    "mesh batching: %s → %s session slots/lane (max %s "
+                    "lanes) at %dx%d (bucket %d)", spec,
+                    getattr(coord, "slots_per_lane", "?"),
+                    getattr(coord, "max_lanes", "?"), st.width, st.height,
+                    len(self.mesh_coordinators))
             except Exception:
                 logger.exception(
                     "mesh coordinator for %dx%d (%s) unavailable; that "
@@ -1606,6 +1797,9 @@ class DataStreamingServer:
                 return None
         facade = coord.acquire(st.width, st.height)
         if facade is None:
+            # races the admission verdict lost (two joins for the last
+            # slot) land here: serve them solo rather than dropping a
+            # session the front door already admitted
             self.mesh_stats["solo_fallback"] += 1
             logger.warning(
                 "mesh batching: no slot for %s at %dx%d; solo encoder",
@@ -1733,7 +1927,31 @@ class DataStreamingServer:
                     if k in summ:
                         d[k] = summ[k]
             displays[did] = d
-        return pack_system_health(displays)
+        # session-scheduler slot health (ISSUE 14, docs/scaling.md): the
+        # per-slot fault domains lived only in coordinator stats() before
+        # — a quarantined slot or a live migration must reach the client
+        # overlay and the dashboard, not just a debugger
+        mesh: Dict[str, Any] = {}
+        for (w, h, profile), coord in list(self.mesh_coordinators.items()):
+            try:
+                cs = coord.stats()
+            except Exception:
+                continue
+            mesh[f"{w}x{h}/{profile}"] = {
+                "active_sessions": cs.get("active_sessions", 0),
+                "lanes": cs.get("lanes", 0),
+                "capacity_slots": cs.get("capacity_slots", 0),
+                "free_slots": cs.get("free_slots", 0),
+                "quarantined_slots": cs.get("quarantined_slots", 0),
+                "slot_errors": cs.get("slot_errors", []),
+                "tick_errors_total": cs.get("tick_errors_total", 0),
+                "worker_restarts_total":
+                    cs.get("worker_restarts_total", 0),
+                "inflight_batches": cs.get("inflight_batches", 0),
+                "migrations_total": cs.get("migrations_total", 0),
+                "lane_detail": cs.get("lane_detail", []),
+            }
+        return pack_system_health(displays, mesh=mesh or None)
 
     def _publish_health_metrics(self) -> None:
         """Recompute the health gauges from current state — recovery and
@@ -1908,9 +2126,39 @@ class DataStreamingServer:
                     net["mesh_worker_restarts"] = sum(
                         coord.worker_restarts_total
                         for coord in self.mesh_coordinators.values())
+                    # scheduler health (ISSUE 14): lane capacity feeds the
+                    # admission verdicts; quarantines/migrations say the
+                    # fault-domain machinery is actually firing
+                    sched = self.scheduler_stats()
+                    if sched is not None:
+                        net["mesh_lanes"] = sched["lanes"]
+                        net["mesh_slots_free"] = sched["slots_free"]
+                        net["mesh_quarantined_slots"] = \
+                            sched["quarantined_slots"]
+                    net["mesh_migrations_total"] = sum(
+                        getattr(coord, "migrations_total", 0)
+                        for coord in self.mesh_coordinators.values())
+                    if self.metrics is not None:
+                        coord_stats = [c.stats() for c in
+                                       self.mesh_coordinators.values()]
+                        self.metrics.set_mesh_health(
+                            active_sessions=net["mesh_sessions"],
+                            lanes=net.get("mesh_lanes", 0),
+                            inflight=sum(
+                                cs.get("inflight_batches", 0)
+                                for cs in coord_stats),
+                            slot_errors=sum(
+                                sum(cs.get("slot_errors", []))
+                                for cs in coord_stats),
+                            tick_errors=net["mesh_tick_errors"],
+                            worker_restarts=net["mesh_worker_restarts"],
+                            quarantined=net.get(
+                                "mesh_quarantined_slots", 0),
+                            migrations=net["mesh_migrations_total"])
                 edge = self.edge_stats
                 if (edge["protocol_errors"] or edge["rate_limited"]
                         or edge["sessions_rejected"]
+                        or edge["sessions_queued"]
                         or edge["slow_client_evictions"]):
                     # hostile-client activity rides the stats feed so a
                     # dashboardless operator still sees it
@@ -1918,6 +2166,7 @@ class DataStreamingServer:
                         "protocol_errors": edge["protocol_errors"],
                         "rate_limited": dict(edge["rate_limited"]),
                         "sessions_rejected": edge["sessions_rejected"],
+                        "sessions_queued": edge["sessions_queued"],
                         "slow_client_evictions":
                             edge["slow_client_evictions"],
                         "load_shedding": self._load_shedding,

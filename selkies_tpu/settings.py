@@ -384,6 +384,29 @@ SETTING_DEFINITIONS: List[Spec] = [
             "KILL server_full until the drop rate recovers (0 = disabled).",
             server_only=True),
 
+    # --- Session scheduler / slot fault domains (server-only;
+    # --- docs/scaling.md) ---
+    IntSpec("mesh_max_lanes", 4, "Batch lanes per mesh geometry bucket: "
+            "each lane is one compiled SPMD encoder whose slots admit "
+            "sessions dynamically; lanes are built on demand up to this "
+            "cap and retired when drained.", server_only=True),
+    IntSpec("admission_queue_ms", 250, "How long a display join may wait "
+            "in the admission queue for a scheduler slot to free before "
+            "it is shed with KILL server_full (0 = shed immediately).",
+            server_only=True),
+    IntSpec("slot_quarantine_errors", 3, "Per-slot error EWMA threshold: "
+            "roughly this many attributed errors within the health window "
+            "quarantines the slot and live-migrates its session to a "
+            "healthy lane.", server_only=True),
+    IntSpec("slot_health_window_s", 30, "Half-life (seconds) of the "
+            "per-slot error score: a slot's past errors decay over this "
+            "window, so only sustained faulting trips quarantine.",
+            server_only=True),
+    BoolSpec("mesh_overflow_solo", False, "When the scheduler is out of "
+             "lane capacity, serve the overflow display with a solo "
+             "encoder pipeline (pre-scheduler behavior) instead of "
+             "queue/shed admission verdicts.", server_only=True),
+
     # --- TPU-native additions (server-only) ---
     IntSpec("tpu_stripe_height", 64, "Encoder stripe height in rows (multiple of 16).",
             server_only=True),

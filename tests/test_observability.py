@@ -207,26 +207,28 @@ def test_mesh_submit_seq_accounts_for_inflight_window():
     """Regression (review finding): with frames in the in-flight window,
     _submit must return the seq the NEW frame will harvest under — not
     the in-flight frame's — or trace correlation shifts off by one in
-    mesh steady state."""
-    import threading
-    from collections import deque
-
+    mesh steady state. Stale-generation entries (a migrated binding's
+    leftovers) must NOT count: their harvests are dropped, not
+    delivered."""
     from selkies_tpu.parallel.coordinator import MeshEncodeCoordinator
+    from selkies_tpu.robustness import FakeMeshEncoder
 
-    coord = object.__new__(MeshEncodeCoordinator)
-    coord._lock = threading.Lock()
-    coord._attached = {0: True}
-    coord._pending = {}
-    coord._seq = {0: 5}
-    coord._gen = [2]
-    coord._inflight_q = deque([
-        ("pend_a", [(0, 2)], (0.0, 0.0)),     # same gen: counts
-        ("pend_b", [(0, 1)], (0.0, 0.0)),     # stale gen: must not
-    ])
-    coord._kick = threading.Event()
-    assert coord._submit(0, "frame") == 6     # 5 + 1 in-flight (gen 2)
+    coord = MeshEncodeCoordinator(
+        "session:1", 1, 64, 48, enc_factory=lambda n: FakeMeshEncoder(n),
+        slots_per_lane=1, max_lanes=1)
+    coord.stop()                       # no ticking: window driven by hand
+    facade = coord.acquire(64, 48)
+    coord.stop()
+    with coord._lock:
+        sess = coord._sessions[facade.sid]
+        sess.seq = 5
+        sess.lane.inflight_q.append(
+            (object(), [(sess, 0, sess.gen)], (0.0, 0.0)))      # counts
+        sess.lane.inflight_q.append(
+            (object(), [(sess, 0, sess.gen - 1)], (0.0, 0.0)))  # stale
+    assert facade.try_submit("frame") == 6    # 5 + 1 in-flight (live gen)
     # a second submit before the tick replaces the pending frame: drop
-    assert coord._submit(0, "frame2") is None
+    assert facade.try_submit("frame2") is None
 
 
 def test_frame_tracer_compat_shim():

@@ -423,50 +423,44 @@ async def test_stop_display_teardown_is_exception_safe():
 
 
 def test_mesh_tick_failure_attributes_slots_and_unblocks_flush():
-    import threading
-
+    """A failed lane dispatch charges the slots that were in that tick
+    and releases their in-flight holds — a stranded hold would block
+    facade.flush for its full timeout (ISSUE 14: failures are contained
+    to the lane; the worker thread never sees them)."""
     from selkies_tpu.parallel.coordinator import MeshEncodeCoordinator
 
-    coord = object.__new__(MeshEncodeCoordinator)
-    coord.n_sessions = 2
-    coord._lock = threading.Lock()
-    coord._free = []
-    coord._attached = {0: True, 1: True}
-    coord._pending = {0: "frame0", 1: "frame1"}
-    coord._results = {0: [], 1: []}
-    coord._traces = {0: {}, 1: {}}
-    coord._seq = {0: 0, 1: 0}
-    coord._want_key = set()
-    coord._want_reset = set()
-    from collections import deque as _deque
-    coord._inflight_q = _deque()
-    coord._inflight_slots = set()
-    coord.max_inflight = 2
-    coord.inflight_batches_max = 0
-    coord._kick = threading.Event()
-    coord._stop = threading.Event()
-    coord._thread = None
-    coord.coded_bytes = [0, 0]
-    coord._gen = [0, 0]
-    coord.slot_errors = [0, 0]
-    coord.tick_errors_total = 0
-    coord._consecutive_tick_failures = 0
-    coord.worker_restarts_total = 0
-
     class BadEnc:
+        n_sessions = 2
+
+        def reset_session(self, s):
+            pass
+
+        def force_keyframe(self, s):
+            pass
+
         def dispatch(self, frames):
             raise RuntimeError("device gone")
 
-    coord.enc = BadEnc()
-    with pytest.raises(RuntimeError):
-        coord._tick()
-    # the failed slots are attributed AND not stranded in _inflight_slots
-    # (a stranded slot would block facade.flush for its full timeout)
-    assert coord.slot_errors == [1, 1]
-    assert coord._inflight_slots == set()
-    assert coord._pending == {}
+    coord = MeshEncodeCoordinator(
+        "session:1", 2, 64, 48, enc_factory=lambda n: BadEnc(),
+        slots_per_lane=2, max_lanes=1, framerate=60.0,
+        health_sick_errors=100)
+    coord.stop()                    # drive the tick by hand
+    fa = coord.acquire(64, 48)
+    fb = coord.acquire(64, 48)
+    coord.stop()
+    fa.try_submit("frame0")
+    fb.try_submit("frame1")
+    coord._tick()                   # contained: does NOT raise
     st_stats = coord.stats()
     assert st_stats["slot_errors"] == [1, 1]
+    assert st_stats["tick_errors_total"] == 1
+    # the holds were released: flush returns immediately, no wedge
+    t0 = time.monotonic()
+    assert fa.flush() == []
+    assert time.monotonic() - t0 < 1.0
+    assert coord.verify_slot_accounting() == []
+    coord.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,506 @@
+"""Session scheduler + swarm churn tests (ISSUE 14, docs/scaling.md).
+
+Three layers:
+
+* pure policy — :class:`SlotHealth` EWMAs/quarantine and the keyed
+  ``mesh.slot_raise`` fault grammar;
+* the scheduler — ``MeshEncodeCoordinator`` with injected (device-free)
+  :class:`FakeMeshEncoder` lanes: dynamic lane growth/retirement,
+  lane-contained failures, quarantine + live migration, churn with zero
+  slot leaks, flush never wedging mid-rebalance;
+* the serving plane — scheduler-driven admission verdicts through the
+  real ``ws_handler`` and the swarm churn harness (tools/swarm_run.py)
+  smoke: ~32 clients, one fault-injected slot, zero leaked slots, zero
+  open trace spans, no cross-session stall. The 500-client soak is
+  slow-marked.
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+
+from selkies_tpu.parallel.coordinator import MeshEncodeCoordinator
+from selkies_tpu.robustness import (FakeMeshEncoder, FaultInjector,
+                                    InProcessClient, SlotHealth)
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def make_coord(slots_per_lane=2, max_lanes=3, framerate=200.0,
+               lane_retire_s=5.0, sick_errors=3, encs=None, **kw):
+    def factory(n):
+        enc = FakeMeshEncoder(n)
+        if encs is not None:
+            encs.append(enc)
+        return enc
+
+    return MeshEncodeCoordinator(
+        "session:1", slots_per_lane, 64, 48, enc_factory=factory,
+        slots_per_lane=slots_per_lane, max_lanes=max_lanes,
+        framerate=framerate, health_sick_errors=sick_errors,
+        health_window_s=30.0, lane_retire_s=lane_retire_s, **kw)
+
+
+def pump_until(pred, coord_facades, timeout=5.0, interval=0.005):
+    """Submit/poll every facade until pred() or timeout; returns per-
+    facade harvested counts."""
+    counts = [0] * len(coord_facades)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and not pred():
+        for i, f in enumerate(coord_facades):
+            if not f.closed:
+                f.try_submit(b"frame")
+                counts[i] += len(f.poll())
+        time.sleep(interval)
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# pure policy
+
+
+def test_slot_health_ewma_decay_and_quarantine():
+    t = [0.0]
+    h = SlotHealth(2, sick_errors=3.0, window_s=10.0, clock=lambda: t[0])
+    assert not h.is_sick(0)
+    for _ in range(3):
+        h.record_error(0)
+    assert h.is_sick(0)
+    assert not h.is_sick(1)           # the neighbour slot is untouched
+    # decay: one half-life halves the score below the threshold
+    t[0] += 10.0
+    assert not h.is_sick(0)
+    assert h.errors_total[0] == 3     # lifetime counter never decays
+    # quarantine is sticky and ends sickness (out of service != sick)
+    for _ in range(4):
+        h.record_error(1)
+    h.quarantine(1)
+    assert not h.is_sick(1)
+    assert h.state()["quarantined"] == [1]
+    # latency EWMA is observability only
+    h.record_ok(0, latency_ms=10.0)
+    h.record_ok(0, latency_ms=20.0)
+    assert 10.0 < h.latency_ewma_ms[0] < 20.0
+
+
+def test_should_fire_for_keyed_arming():
+    f = FaultInjector()
+    f.arm("mesh.slot_raise", times=2, arg="7:1")
+    assert not f.should_fire_for("mesh.slot_raise", "7:0", 0)
+    assert "mesh.slot_raise" in f.armed      # non-match never consumes
+    assert f.should_fire_for("mesh.slot_raise", "7:1", 1)
+    # bare-slot identity matches too (single-lane chaos arms "1")
+    f.arm("mesh.slot_raise", times=1, arg="1")
+    assert f.should_fire_for("mesh.slot_raise", "9:1", 1)
+    # argless arming fires for the first site checked
+    f.arm("mesh.slot_raise", times=1)
+    assert f.should_fire_for("mesh.slot_raise", "3:0", 0)
+    assert f.fired["mesh.slot_raise"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# the scheduler: dynamic lanes
+
+
+def test_lanes_grow_on_demand_and_retire_when_drained():
+    coord = make_coord(slots_per_lane=2, max_lanes=2, lane_retire_s=0.0)
+    try:
+        fs = [coord.acquire(64, 48) for _ in range(4)]
+        assert all(f is not None for f in fs)
+        assert coord.stats()["lanes"] == 2          # grew on demand
+        assert coord.acquire(64, 48) is None        # genuinely full
+        cap = coord.capacity()
+        assert cap["slots_free"] == 0 and cap["growable_slots"] == 0
+        # geometry mismatch is still a hard no
+        assert coord.acquire(128, 128) is None
+        for f in fs:
+            f.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and coord.stats()["lanes"] > 1:
+            coord._kick.set()
+            time.sleep(0.01)
+        st = coord.stats()
+        # drained lanes retire; ONE healthy lane stays warm
+        assert st["lanes"] == 1
+        assert st["lanes_retired_total"] >= 1
+        assert st["active_sessions"] == 0
+        assert coord.verify_slot_accounting() == []
+    finally:
+        coord.stop()
+
+
+def test_lane_failure_is_contained_and_attributed():
+    """A failing lane charges its own slots and backs off by itself;
+    the cohabiting lane keeps streaming and flush never wedges."""
+    encs = []
+    coord = make_coord(slots_per_lane=1, max_lanes=2, encs=encs,
+                       sick_errors=100)     # no migration in this test
+    try:
+        fa = coord.acquire(64, 48)
+        fb = coord.acquire(64, 48)          # second lane
+        assert coord.stats()["lanes"] == 2
+        pump_until(lambda: False, [fa, fb], timeout=0.1)
+        encs[0].fail_dispatches = 2
+        counts = pump_until(
+            lambda: coord.tick_errors_total >= 2, [fa, fb], timeout=5.0)
+        st = coord.stats()
+        assert st["tick_errors_total"] >= 2
+        assert sum(st["slot_errors"]) >= 2          # attributed per slot
+        assert counts[1] > 0                        # lane B kept flowing
+        # worker thread survived the lane failures
+        assert coord._thread is not None and coord._thread.is_alive()
+        # flush is deadline-bounded and does not wedge on the sick lane
+        t0 = time.monotonic()
+        fa.flush()
+        assert time.monotonic() - t0 < 3.0
+        assert coord.verify_slot_accounting() == []
+    finally:
+        coord.stop()
+
+
+def test_tick_raise_fault_hits_every_lane_but_worker_survives():
+    coord = make_coord(slots_per_lane=2)
+    coord.faults = FaultInjector()
+    try:
+        f = coord.acquire(64, 48)
+        pump_until(lambda: False, [f], timeout=0.1)
+        errors_before = coord.tick_errors_total
+        coord.faults.arm("mesh.tick_raise", times=1)
+        counts = pump_until(
+            lambda: coord.tick_errors_total > errors_before
+            and coord.faults.fired.get("mesh.tick_raise", 0) >= 1,
+            [f], timeout=5.0)
+        assert coord.faults.fired["mesh.tick_raise"] == 1
+        assert coord.tick_errors_total > errors_before
+        # recovery: frames flow again after the backoff
+        n0 = counts[0]
+        counts = pump_until(lambda: False, [f], timeout=1.5)
+        assert counts[0] > 0 or n0 > 0
+        assert coord._thread is not None and coord._thread.is_alive()
+    finally:
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# quarantine + live migration
+
+
+def test_sick_slot_quarantined_session_migrates_cohabitant_streams():
+    coord = make_coord(slots_per_lane=2, max_lanes=2, sick_errors=3)
+    coord.faults = FaultInjector()
+    try:
+        victim = coord.acquire(64, 48)
+        cohab = coord.acquire(64, 48)
+        lane0, slot0 = victim.lane_id, victim.slot
+        coord.faults.arm("mesh.slot_raise", times=4,
+                         arg=f"{lane0}:{slot0}")
+        counts = pump_until(lambda: coord.migrations_total >= 1,
+                            [victim, cohab], timeout=5.0)
+        st = coord.stats()
+        assert st["migrations_total"] == 1
+        assert st["quarantined_total"] == 1
+        assert st["slot_faults_total"] >= 3
+        # the facade survived the rebind: new lane, migration flag set
+        assert victim.lane_id != lane0
+        assert victim.consume_migration() is True
+        assert victim.consume_migration() is False     # one-shot
+        # the cohabitant never stopped streaming through the fault
+        assert counts[1] > 0
+        # and the victim streams again on the new lane
+        counts = pump_until(lambda: False, [victim, cohab], timeout=0.6)
+        assert counts[0] > 0
+        assert coord.verify_slot_accounting() == []
+        # the quarantined slot never returns to the free list
+        sick_lane = next((ln for ln in coord.lanes if ln.id == lane0),
+                         None)
+        if sick_lane is not None:
+            assert slot0 in sick_lane.health.quarantined
+            assert slot0 not in sick_lane.free
+    finally:
+        coord.stop()
+
+
+def test_migration_blocked_at_full_occupancy_keeps_serving():
+    """No healthy slot anywhere: the sick session keeps its slot
+    (degraded beats dead), the block is counted, and nothing leaks."""
+    coord = make_coord(slots_per_lane=1, max_lanes=1, sick_errors=2)
+    coord.faults = FaultInjector()
+    try:
+        f = coord.acquire(64, 48)
+        coord.faults.arm("mesh.slot_raise", times=3,
+                         arg=f"{f.lane_id}:{f.slot}")
+        pump_until(lambda: coord.migrations_blocked_total >= 1, [f],
+                   timeout=5.0)
+        assert coord.migrations_blocked_total >= 1
+        assert coord.migrations_total == 0
+        # still serving on the sick slot once the faults are exhausted
+        counts = pump_until(lambda: False, [f], timeout=0.6)
+        assert counts[0] > 0
+        assert coord.verify_slot_accounting() == []
+    finally:
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# churn regression (satellite): no leaks, gen guards, flush never wedges
+
+
+def test_churn_storm_no_slot_leaks_and_flush_never_wedges():
+    rng = random.Random(7)
+    coord = make_coord(slots_per_lane=4, max_lanes=3, lane_retire_s=0.05)
+    try:
+        live = []
+        for step in range(300):
+            r = rng.random()
+            if r < 0.45 or not live:
+                f = coord.acquire(64, 48)
+                if f is not None:
+                    live.append(f)
+            elif r < 0.75:
+                f = live.pop(rng.randrange(len(live)))
+                f.try_submit(b"parting-frame")
+                if rng.random() < 0.5:
+                    # flush mid-rebalance must return, not wedge
+                    t0 = time.monotonic()
+                    f.flush()
+                    assert time.monotonic() - t0 < 3.0
+                f.close()
+            else:
+                f = rng.choice(live)
+                f.try_submit(b"frame")
+                f.poll()
+            if step % 50 == 0:
+                assert coord.verify_slot_accounting() == []
+        for f in live:
+            f.close()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline \
+                and coord.stats()["active_sessions"]:
+            time.sleep(0.01)
+        st = coord.stats()
+        assert st["active_sessions"] == 0
+        assert coord.verify_slot_accounting() == []
+    finally:
+        coord.stop()
+
+
+def test_generation_guard_on_slot_reuse():
+    """A released session's in-flight result must never reach the slot's
+    next occupant, and a migrated session must not receive its old
+    binding's pixels."""
+    coord = make_coord(slots_per_lane=1, max_lanes=1, framerate=50.0)
+    try:
+        f1 = coord.acquire(64, 48)
+        f1.try_submit(b"old-occupant-frame")
+        # release while the frame may still be pending/in-flight, then
+        # immediately reuse the slot
+        f1.close()
+        f2 = coord.acquire(64, 48)
+        assert f2 is not None and f2.slot == 0
+        # whatever lands on f2 must be ITS frames, numbered from seq 0
+        f2.try_submit(b"new-occupant-frame")
+        got = []
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and not got:
+            got = f2.poll()
+            time.sleep(0.01)
+        assert got and got[0][0] == 0        # fresh seq for the new owner
+        assert f1.poll() == []               # the dead facade gets nothing
+        assert coord.verify_slot_accounting() == []
+    finally:
+        coord.stop()
+
+
+def test_submit_seq_accounts_for_inflight_window():
+    """With frames in the in-flight window, _submit must return the seq
+    the NEW frame will harvest under — not the in-flight frame's — or
+    trace correlation shifts off by one in overlapped steady state."""
+    coord = make_coord(slots_per_lane=1, max_lanes=1)
+    coord.stop()                             # drive ticks by hand
+    f = coord.acquire(64, 48)
+    coord.stop()
+    with coord._lock:
+        sess = coord._sessions[f.sid]
+        sess.seq = 5
+        lane = sess.lane
+        lane.inflight_q.append(
+            (object(), [(sess, 0, sess.gen)], (0.0, 0.0)))          # live
+        lane.inflight_q.append(
+            (object(), [(sess, 0, sess.gen - 1)], (0.0, 0.0)))      # stale
+    assert f.try_submit(b"frame") == 6       # 5 + 1 live in-flight
+    # a second submit before the tick replaces the pending frame: drop
+    assert f.try_submit(b"frame2") is None
+
+
+# ---------------------------------------------------------------------------
+# serving plane: scheduler-driven admission through the real ws_handler
+
+
+def make_admission_server(slots_per_lane=1, max_lanes=1, queue_ms=60):
+    from selkies_tpu.server.app import StreamingApp
+    from selkies_tpu.server.data_server import DataStreamingServer
+    from selkies_tpu.settings import Settings
+    from tools.swarm_run import _SwarmSoloEncoder, _SwarmSource
+
+    env = {
+        "SELKIES_PORT": "0", "SELKIES_AUDIO_ENABLED": "false",
+        "SELKIES_SECOND_SCREEN": "true",
+        "SELKIES_MAX_CLIENTS": "0", "SELKIES_MAX_DISPLAYS": "0",
+        "SELKIES_TPU_MESH": "session:1",
+        "SELKIES_TPU_SESSIONS_PER_CHIP": str(slots_per_lane),
+        "SELKIES_MESH_MAX_LANES": str(max_lanes),
+        "SELKIES_ADMISSION_QUEUE_MS": str(queue_ms),
+        "SELKIES_WATCHDOG_FRAMES": "0",
+        "SELKIES_SUPERVISOR_MAX_RESTARTS": "1000",
+        "SELKIES_RESIZE_DEBOUNCE_MS": "10",
+    }
+    settings = Settings(argv=[], env=env)
+    app = StreamingApp(settings)
+    server = DataStreamingServer(
+        settings, app=app,
+        encoder_factory=lambda w, h, s, overrides=None:
+            _SwarmSoloEncoder(),
+        source_factory=_SwarmSource, host="127.0.0.1")
+    server.coordinator_factory = \
+        lambda spec, spc, w, h, **kw: MeshEncodeCoordinator(
+            spec, spc, w, h,
+            enc_factory=lambda n: FakeMeshEncoder(n),
+            slots_per_lane=slots_per_lane, lane_retire_s=0.2,
+            **{k: v for k, v in kw.items()
+               if k != "slots_per_lane"})
+    app.data_server = server
+    return server
+
+
+async def open_display(server, display_id, w=64, h=48, fps=30):
+    ws = InProcessClient()
+    task = asyncio.create_task(server.ws_handler(ws))
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and len(ws.sent) < 2:
+        await asyncio.sleep(0.005)
+    ws.feed("SETTINGS," + json.dumps({
+        "displayId": display_id, "initialClientWidth": w,
+        "initialClientHeight": h, "framerate": fps}))
+    return ws, task
+
+
+async def reap(ws, task):
+    await ws.close()
+    try:
+        await asyncio.wait_for(task, 5.0)
+    except asyncio.TimeoutError:
+        task.cancel()
+
+
+@pytest.mark.anyio
+async def test_admission_queue_then_shed_then_readmit():
+    """Capacity 1: the second display queues then is shed with
+    KILL server_full; after the first leaves, a third is admitted."""
+
+    async def frames_flowing(ws, timeout=5.0):
+        n0 = len(ws.binary())
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(ws.binary()) > n0:
+                return True
+            await asyncio.sleep(0.01)
+        return False
+
+    server = make_admission_server(slots_per_lane=1, max_lanes=1)
+    try:
+        ws1, t1 = await open_display(server, "d1")
+        assert await frames_flowing(ws1)
+
+        ws2, t2 = await open_display(server, "d2")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not ws2.closed:
+            await asyncio.sleep(0.01)
+        assert ws2.closed                      # shed after the queue wait
+        assert any("KILL server_full" in t for t in ws2.texts())
+        assert server.edge_stats["sessions_queued"] >= 1
+        assert server.edge_stats["sessions_rejected"] >= 1
+        await reap(ws2, t2)
+
+        await reap(ws1, t1)                    # leave frees the slot
+        ws3, t3 = await open_display(server, "d3")
+        assert await frames_flowing(ws3)
+        assert not ws3.closed
+        await reap(ws3, t3)
+    finally:
+        await server.stop()
+
+
+@pytest.mark.anyio
+async def test_admission_queue_admits_when_slot_frees_during_wait():
+    server = make_admission_server(slots_per_lane=1, max_lanes=1,
+                                   queue_ms=1500)
+    try:
+        ws1, t1 = await open_display(server, "d1")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not any(
+                isinstance(m, (bytes, bytearray)) for m in ws1.sent):
+            await asyncio.sleep(0.01)
+        # join while full, then free the slot inside the queue window
+        ws2, t2 = await open_display(server, "d2")
+        await asyncio.sleep(0.15)
+        assert not ws2.closed                  # still queued, not shed
+        await reap(ws1, t1)
+        deadline = time.monotonic() + 5.0
+        ok = False
+        while time.monotonic() < deadline:
+            if any(isinstance(m, (bytes, bytearray)) for m in ws2.sent):
+                ok = True
+                break
+            await asyncio.sleep(0.01)
+        assert ok and not ws2.closed           # admitted after the wait
+        assert server.edge_stats["sessions_queued"] >= 1
+        await reap(ws2, t2)
+    finally:
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the swarm harness (acceptance): tier-1 smoke + slow soak
+
+
+@pytest.mark.anyio
+async def test_swarm_smoke_churn_storm_with_sick_slot():
+    """~32 clients, join/leave/resize storm, one slot fault-injected:
+    the victim is quarantined + migrated, cohabitants never stall, and
+    the run ends with zero leaked slots and zero open trace spans."""
+    from tools.swarm_run import swarm_run
+
+    report = await swarm_run(n_clients=32, duration_s=3.0, seed=1,
+                             concurrency=12, fps=15.0, slots_per_lane=4,
+                             max_lanes=2, sick_slot=True)
+    assert report["swarm_clients"] >= 32
+    assert report["leaked_slots"] == 0
+    assert report["trace_open_spans"] == 0
+    assert report["slot_accounting_violations"] == []
+    assert report["victim_migrated"] is True
+    assert report["cohabitants_stalled"] == 0
+    assert report["quarantined_slots"] + report.get(
+        "migrations", 0) >= 1
+    assert report["frames_delivered_total"] > 0
+    assert report["alive"] is True
+
+
+@pytest.mark.slow
+@pytest.mark.anyio
+async def test_swarm_soak_500_clients():
+    """The acceptance-scale storm: 500 distinct clients through the real
+    ws_handler, ending leak-free with the fault-domain story proven."""
+    from tools.swarm_run import swarm_run
+
+    report = await swarm_run(n_clients=500, duration_s=20.0, seed=2,
+                             concurrency=56, sick_slot=True)
+    assert report["swarm_clients"] >= 500
+    assert report["alive"] is True
+    assert report["fairness_jain_index"] > 0.8
+    assert report["sessions_per_chip"] >= 32

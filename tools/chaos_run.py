@@ -47,17 +47,46 @@ FAULT_MENU = (
     ("ws.drop", 1, None),
     ("ws.flood", 1, None),
     ("ws.garbage", 1, None),
+    ("session.churn", 1, None),
+)
+
+#: mesh scheduler kinds (ISSUE 14): only drawn with --mesh, because their
+#: call sites live in the coordinator's tick thread — tick_raise fails a
+#: whole tick (worker backs off, survives), slot_raise fails ONE slot's
+#: dispatch (cohabitants keep streaming; repeated hits quarantine the
+#: slot and live-migrate its session, docs/scaling.md)
+MESH_FAULT_MENU = (
+    ("mesh.tick_raise", 1, None),
+    ("mesh.slot_raise", 3, None),
 )
 
 #: edge fault kinds (ISSUE 3): injected from the CLIENT side — a message
 #: flood / garbage burst through the websocket, exercising the rate
 #: limiter and per-message exception boundary rather than a server-side
 #: fault point (server.faults has no call site that can forge client
-#: input)
-CLIENT_FAULTS = ("ws.flood", "ws.garbage")
+#: input). session.churn is a storm of short-lived extra clients joining
+#: and leaving mid-faults — admission, fan-out registration, and teardown
+#: must all hold while the interior is being broken.
+CLIENT_FAULTS = ("ws.flood", "ws.garbage", "session.churn")
 
 
 from selkies_tpu.robustness.testing import InProcessClient as _ChaosClient  # noqa: E402
+
+
+async def _churn_burst(server, rng) -> None:
+    """session.churn: a burst of short-lived clients joins and leaves
+    while the primary session is under fault injection — the scheduler
+    and fan-out tables must absorb the membership churn without touching
+    the session being tested."""
+    for _ in range(5):
+        ws = _ChaosClient()
+        task = asyncio.create_task(server.ws_handler(ws))
+        await asyncio.sleep(rng.uniform(0.02, 0.08))
+        await ws.close()
+        try:
+            await asyncio.wait_for(task, 2.0)
+        except asyncio.TimeoutError:
+            task.cancel()
 
 
 def _inject_client_fault(ws, point: str, rng) -> None:
@@ -77,7 +106,7 @@ def _inject_client_fault(ws, point: str, rng) -> None:
 
 async def chaos_session(duration_s: float = 10.0, seed: int = 0,
                         width: int = 160, height: int = 128,
-                        fps: float = 30.0) -> dict:
+                        fps: float = 30.0, mesh: bool = False) -> dict:
     """Run one chaos session; returns the survival report."""
     import tempfile
 
@@ -114,6 +143,12 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
         "SELKIES_LADDER_FAIL_THRESHOLD": "3",
         "SELKIES_LADDER_PROBE_MS": "2000",
     }
+    if mesh:
+        # the session rides the mesh scheduler instead of a solo encoder,
+        # so the mesh.tick_raise / mesh.slot_raise kinds have a live
+        # call site (docs/scaling.md)
+        env["SELKIES_TPU_MESH"] = "session:1"
+        env["SELKIES_TPU_SESSIONS_PER_CHIP"] = "2"
     settings = Settings(argv=[], env=env)
 
     # warm the jit cache outside the session so a cold compile is not
@@ -189,8 +224,11 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
                 await reap(ws, task)
                 ws, task = await connect()
                 reconnects += 1
-            point, times, arg = FAULT_MENU[rng.randrange(len(FAULT_MENU))]
-            if point in CLIENT_FAULTS:
+            menu = FAULT_MENU + (MESH_FAULT_MENU if mesh else ())
+            point, times, arg = menu[rng.randrange(len(menu))]
+            if point == "session.churn":
+                await _churn_burst(server, rng)
+            elif point in CLIENT_FAULTS:
                 _inject_client_fault(ws, point, rng)
             else:
                 server.faults.arm(point, times=times, arg=arg)
@@ -207,6 +245,12 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
                 await reap(ws, task)
                 ws, task = await connect()
                 reconnects += 1
+            st_now = server.display_clients.get("primary")
+            if st_now is not None and not st_now.video_active:
+                # a ws.garbage burst can carry a legitimate owner
+                # STOP_VIDEO; a real client would press play again —
+                # recovery models that, it does not test amnesia
+                ws.feed("START_VIDEO")
             n0 = ws.n_frames()
             await asyncio.sleep(0.5)
             if not ws.closed and ws.n_frames() > n0:
@@ -237,14 +281,31 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
         # EVERY span opened during the fault storm must have reached a
         # terminal mark — dropped frames included. A nonzero residue
         # here is a span leak, and the run fails on it.
+        coords = list(server.mesh_coordinators.values())
         await reap(ws, task)
         await server.stop()
         report["trace_open_spans"] = server.recorder.open_spans()
         report["frames_traced"] = server.recorder.closed_total
         report["trace_dropped"] = server.recorder.dropped_total
         report["trace_acked"] = server.recorder.acked_total
+        leaked_slots = 0
+        if coords:
+            # scheduler leak invariant (ISSUE 14): the storm must not
+            # strand sessions or slots in the mesh scheduler either
+            leaked_slots = sum(c.active_sessions for c in coords) + len(
+                [p for c in coords for p in c.verify_slot_accounting()])
+            report["mesh_leaked_slots"] = leaked_slots
+            report["mesh_tick_errors"] = sum(
+                c.tick_errors_total for c in coords)
+            report["mesh_slot_faults"] = sum(
+                c.slot_faults_total for c in coords)
+            report["mesh_quarantined"] = sum(
+                c.quarantined_total for c in coords)
+            report["mesh_migrations"] = sum(
+                c.migrations_total for c in coords)
         report["alive"] = (recovered and server._failed_displays() == 0
-                          and report["trace_open_spans"] == 0)
+                          and report["trace_open_spans"] == 0
+                          and leaked_slots == 0)
         return report
     finally:
         await reap(ws, task)
@@ -258,13 +319,17 @@ def main(argv=None) -> int:
     p.add_argument("--width", type=int, default=160)
     p.add_argument("--height", type=int, default=128)
     p.add_argument("--fps", type=float, default=30.0)
+    p.add_argument("--mesh", action="store_true",
+                   help="run the session through the mesh scheduler and "
+                        "draw mesh.tick_raise / mesh.slot_raise kinds")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.ERROR)
     report = asyncio.run(chaos_session(
         duration_s=args.duration, seed=args.seed,
-        width=args.width, height=args.height, fps=args.fps))
+        width=args.width, height=args.height, fps=args.fps,
+        mesh=args.mesh))
     print(json.dumps(report, indent=2))
     return 0 if report["alive"] else 1
 

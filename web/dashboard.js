@@ -327,6 +327,22 @@ class SelkiesDashboard {
         }
         this.stats["t:" + id] = line;
       }
+      if (s.mesh) {
+        // session-scheduler occupancy per geometry bucket: attached/
+        // capacity slots, lanes, and any quarantined fault domains
+        const parts = Object.entries(s.mesh).map(([bucket, m]) => {
+          let line = bucket + " " + m.active_sessions + "/" +
+            m.capacity_slots + " (" + m.lanes + " lanes)";
+          if (m.quarantined_slots) {
+            line += " q" + m.quarantined_slots;
+          }
+          if (m.migrations_total) {
+            line += " mig" + m.migrations_total;
+          }
+          return line;
+        });
+        this.stats.mesh = parts.join(" | ");
+      }
     }
     this._renderStats();
   }
