@@ -235,13 +235,46 @@ def bench_h264() -> dict:
     return out
 
 
+def _bench_4k_sfe(width=3840, height=2160, max_shards=4,
+                  frames_target=120, seconds=MAX_SECONDS / 4) -> dict:
+    """Split-frame encoding (ISSUE 15): ONE 4K frame's stripe bands
+    sharded across the stripe mesh axis (`MeshH264Encoder`, shard-local
+    device CAVLC, host slice concat), driven with a 2-deep
+    dispatch/harvest window like the coordinator's SFE lanes — the
+    drive discipline is bench_multi.sfe_drive, shared with the
+    `sfe_scaling` series so the two can never diverge. On one chip this
+    measures the mesh-path overhead floor; on a multi-chip slice
+    `fourk_sfe_fps` should scale near-linearly with shard count."""
+    import jax
+
+    import bench_multi
+    from selkies_tpu.parallel import parse_mesh_spec
+    from selkies_tpu.parallel.mesh_h264 import MeshH264Encoder
+
+    devices = jax.devices()
+    shards = min(len(devices), max_shards)
+    mesh = parse_mesh_spec(f"session:1,stripe:{shards}", devices[:shards])
+    enc = MeshH264Encoder(mesh, 1, width, height)
+    d = bench_multi.sfe_drive(enc, frames_target, seconds)
+    return {
+        "fourk_sfe_fps": d["fps"],
+        "fourk_sfe_shards": shards,
+        "fourk_sfe_frames": d["frames"],
+        "fourk_sfe_concat_ms_p50": d["concat_ms_p50"],
+        "fourk_sfe_fetch_ms_p50": d["fetch_ms_p50"],
+        "fourk_sfe_host_fallback_stripes": enc.host_fallback_stripes_total,
+    }
+
+
 def bench_4k() -> dict:
-    """Config 4 single-chip share: 4K JPEG + 4K H.264 throughput.
+    """Config 4: 4K JPEG + 4K H.264 throughput, single-chip AND the
+    split-frame-encoding lane.
 
     The v5e-4 target (30 fps) rides the stripe-axis mesh shard
     (parallel/, validated by __graft_entry__.dryrun_multichip); the
-    per-chip numbers here scale ~linearly with chip count because
-    stripes are independent sequences."""
+    `fourk_sfe_*` fields measure that path live (ISSUE 15) so the
+    speedup over the single-chip `fourk_h264_fps` shows in one BENCH
+    round."""
     fps, done, elapsed, total, jst = _pipelined_jpeg_fps(
         3840, 2160, 120, MAX_SECONDS / 4)
     out = {
@@ -278,6 +311,11 @@ def bench_4k() -> dict:
         out["fourk_h264_fps"] = round(done / el, 2) if el > 0 else 0.0
     except Exception as e:
         out["fourk_h264_error"] = repr(e)
+    try:
+        # ISSUE 15: the SFE lane measured next to the single-chip number
+        out.update(_bench_4k_sfe())
+    except Exception as e:
+        out["fourk_sfe_error"] = repr(e)
     return out
 
 

@@ -34,18 +34,28 @@ MAX_SECONDS = 90.0
 
 
 def bench_mesh() -> dict:
-    """Mesh-batched aggregate: one sharded dispatch per tick for all N."""
+    """Mesh-batched aggregate: one sharded dispatch per tick for all N.
+
+    The mesh geometry honors the full ``session:N,stripe:M`` form of the
+    ``tpu_mesh`` setting (env ``SELKIES_TPU_MESH``) instead of
+    hardcoding the stripe axis to 1 (ISSUE 15 satellite) — so this
+    bench runs on real 2-D meshes: M > 1 stripe-shards every session's
+    frame across chips on top of the session data-parallelism."""
+    import os
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from selkies_tpu.parallel import Mesh, MeshStripeEncoder
+    from selkies_tpu.parallel import MeshStripeEncoder, parse_mesh_spec
 
     devices = jax.devices()
     n_dev = len(devices)
-    mesh = Mesh(np.asarray(devices).reshape(n_dev, 1), ("session", "stripe"))
-    per_chip = max(1, N_SESSIONS // n_dev)
-    n_sessions = per_chip * n_dev
+    spec = os.environ.get("SELKIES_TPU_MESH", "") or f"session:{n_dev}"
+    mesh = parse_mesh_spec(spec, devices)
+    n_sess_ax = mesh.shape["session"]
+    per_chip = max(1, N_SESSIONS // n_sess_ax)
+    n_sessions = per_chip * n_sess_ax
     enc = MeshStripeEncoder(mesh, n_sessions, W, H)
 
     # device-resident scrolling batch: full damage every tick, no H2D cost,
@@ -132,7 +142,12 @@ def bench_mesh() -> dict:
         },
         "mesh_aggregate_fps": round(fps, 2),
         "mesh_sessions": n_sessions,
-        "mesh_devices": n_dev,
+        # the devices the mesh actually spans (a SELKIES_TPU_MESH spec
+        # may use fewer than the host has) — per-chip derivations from
+        # MULTICHIP_*.json must divide by this, not the host inventory
+        "mesh_devices": int(mesh.devices.size),
+        "mesh_spec": (f"session:{n_sess_ax},"
+                      f"stripe:{mesh.shape['stripe']}"),
         "mesh_frames": frames,
         "mesh_mean_frame_kb": round(total_bytes / max(frames, 1) / 1024, 1),
         "mesh_fetch_ms_p50": round(
@@ -142,6 +157,108 @@ def bench_mesh() -> dict:
                              int(len(fetch_sorted) * 0.95))], 2),
         "mesh_d2h_bytes_per_frame": round(d2h_bytes / max(frames, 1)),
     }
+
+
+def sfe_drive(enc, frames_target: int, budget_s: float) -> dict:
+    """Shared SFE drive discipline for one single-session
+    ``MeshH264Encoder`` (used by ``bench_sfe_scaling`` here AND
+    bench.py's ``_bench_4k_sfe``, so the two reported series can never
+    diverge): device-resident scrolling source, IDR + steady-state
+    warmup ticks, then a 2-deep dispatch/harvest window. Returns
+    fps/frames plus the harvest stage samples."""
+    from collections import deque
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from selkies_tpu.capture.synthetic import SyntheticSource
+
+    assert enc.n_sessions == 1
+    base = np.pad(
+        SyntheticSource(enc.width, enc.height, pattern="scroll")._bg,
+        ((0, enc.pad_h - enc.height), (0, enc.pad_w - enc.width), (0, 0)),
+        mode="edge")
+    batch = jax.device_put(jnp.asarray(base[None]), enc._frame_sharding)
+    roll = jax.jit(lambda b: jnp.roll(b, -8, axis=1))
+    enc.encode_frames(batch)          # IDR tick (mixed-program compile)
+    batch = roll(batch)
+    enc.encode_frames(batch)          # steady-state P compile
+
+    frames = 0
+    concat_ms, fetch_ms = [], []
+    pending = deque()
+    start = time.perf_counter()
+
+    def harvest_one():
+        nonlocal frames
+        enc.harvest(pending.popleft())
+        frames += 1
+        st = enc.last_harvest_stages or {}
+        concat_ms.append(st.get("concat_ms", 0.0))
+        fetch_ms.append(st.get("fetch_ms", 0.0))
+
+    while frames < frames_target and \
+            time.perf_counter() - start < budget_s:
+        batch = roll(batch)
+        pending.append(enc.dispatch(batch))  # >=2 sharded batches in flight
+        if len(pending) >= 2:
+            harvest_one()
+    while pending:
+        harvest_one()
+    elapsed = time.perf_counter() - start
+    from selkies_tpu.parallel.coordinator import _p50
+    return {
+        "fps": round(frames / elapsed, 2) if elapsed > 0 else 0.0,
+        "frames": frames,
+        "concat_ms_p50": _p50(concat_ms, 2),
+        "fetch_ms_p50": _p50(fetch_ms, 2),
+    }
+
+
+def bench_sfe_scaling(width: int = 3840, height: int = 2160,
+                      shard_counts=(1, 2, 4), frames_target: int = 96,
+                      budget_per_shard: float = MAX_SECONDS / 6) -> dict:
+    """Split-frame encoding scaling (ISSUE 15 acceptance): ONE 4K H.264
+    session's frames stripe-sharded across 1 / 2 / 4 chips
+    (`MeshH264Encoder` over ``session:1,stripe:M``), identical content
+    and drive discipline per shard count (2-deep dispatch/harvest
+    window), so the fps series isolates the ICI shard speedup. The
+    acceptance bar is >=1.7x at 2 shards over the 1-shard baseline with
+    a near-linear trend to 4. (Geometry parameterized so the code path
+    smoke-tests at toy sizes on CPU hosts.)"""
+    import jax
+
+    from selkies_tpu.parallel import parse_mesh_spec
+    from selkies_tpu.parallel.mesh_h264 import MeshH264Encoder
+
+    devices = jax.devices()
+    series = {}
+    concat = {}
+    for shards in shard_counts:
+        if shards > len(devices):
+            continue
+        mesh = parse_mesh_spec(f"session:1,stripe:{shards}",
+                               devices[:shards])
+        d = sfe_drive(MeshH264Encoder(mesh, 1, width, height),
+                      frames_target, budget_per_shard)
+        series[str(shards)] = d["fps"]
+        concat[str(shards)] = d["concat_ms_p50"]
+    if not series:
+        return {}
+    out = {
+        "sfe_scaling": series,
+        "sfe_concat_ms_p50": concat,
+        "fourk_sfe_fps": max(series.values()),
+        "sfe_shards_best": max(
+            (int(k) for k, v in series.items()
+             if v == max(series.values())), default=1),
+    }
+    if "1" in series and "2" in series and series["1"] > 0:
+        out["sfe_speedup_2shard"] = round(series["2"] / series["1"], 2)
+    if "1" in series and "4" in series and series["1"] > 0:
+        out["sfe_speedup_4shard"] = round(series["4"] / series["1"], 2)
+    return out
 
 
 def bench_swarm() -> dict:
@@ -224,7 +341,16 @@ def main() -> None:
     elapsed = time.perf_counter() - start
 
     fps = done / elapsed if elapsed > 0 else 0.0
-    mesh = bench_mesh()
+    try:
+        mesh = bench_mesh()
+    except Exception as e:          # e.g. a prod SELKIES_TPU_MESH spec
+        mesh = {"mesh_aggregate_fps": 0.0,  # too big for this bench host
+                "mesh_sessions": 0, "mesh_error": repr(e)}
+    try:
+        # ISSUE 15 acceptance series: fps vs SFE shard count at 4K
+        sfe = bench_sfe_scaling()
+    except Exception as e:          # the headline must survive a sub-bench
+        sfe = {"sfe_error": repr(e)}
     # headline: the better mode, with per-session figures computed against
     # THAT mode's session count (mesh may batch more sessions on big slices)
     if mesh["mesh_aggregate_fps"] > fps:
@@ -251,6 +377,7 @@ def main() -> None:
         "elapsed_s": round(elapsed, 2),
         "mean_frame_kb": round(total_bytes / max(done, 1) / 1024, 1),
         **mesh,
+        **sfe,
         **bench_swarm(),
     }))
 

@@ -205,6 +205,18 @@ class Metrics:
             "mesh_sessions_migrated_total", "Sessions live-migrated off "
             "quarantined slots onto healthy lanes (cumulative)",
             registry=self.registry)
+        # ISSUE 15: split-frame encoding — one 4K/8K frame's stripe
+        # bands sharded across chips; the shard fan-out and the
+        # host-side slice-concat wall must be scrapeable
+        self.sfe_shards_g = Gauge(
+            "sfe_shards", "Stripe shards one frame spans on the widest "
+            "active split-frame-encoding lane (0 = no SFE lanes)",
+            registry=self.registry)
+        self.sfe_concat_ms = Gauge(
+            "sfe_concat_ms", "Host wall per mesh tick concatenating "
+            "per-shard slice payloads into access units on SFE lanes "
+            "(recent p50, mirrored from the coordinator)",
+            registry=self.registry)
         # ISSUE 13: flight-recorder stage series — the per-stage latency
         # decomposition behind the glass-to-glass number, labeled by
         # display so a sick session is attributable (docs/observability.md)
@@ -411,6 +423,13 @@ class Metrics:
         self.mesh_worker_restarts.set(worker_restarts)
         self.mesh_quarantined_slots.set(quarantined)
         self.mesh_migrations.set(migrations)
+
+    def set_sfe_health(self, *, shards: int,
+                       concat_ms_p50: float) -> None:
+        """Mirror the SFE lane fan-out + slice-concat wall (stats tick)."""
+        if HAVE_PROM:
+            self.sfe_shards_g.set(shards)
+            self.sfe_concat_ms.set(concat_ms_p50)
 
     def set_clients(self, n: int) -> None:
         if HAVE_PROM:

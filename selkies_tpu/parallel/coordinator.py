@@ -71,6 +71,15 @@ LANE_BUILD_BLOCK_S = 30.0
 _lane_ids = itertools.count()
 
 
+def _p50(samples, ndigits: int = 3) -> float:
+    """Median of a small sample window (0.0 when empty). Shared with
+    the bench reporters so every ``*_ms_p50`` surface agrees."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return round(s[len(s) // 2], ndigits)
+
+
 class MeshSessionFacade:
     """One session's encoder-shaped handle onto the coordinator.
 
@@ -116,10 +125,11 @@ class MeshSessionFacade:
     def pop_trace(self, seq: int):
         """Flight-recorder stage intervals for a harvested frame.
 
-        Mesh attribution is coarser than the solo pipelines: the sharded
-        harvest interleaves the D2H fetch with host assembly, so the
-        whole harvest wall rides ``fetch_wait`` and there is no separate
-        ``pack`` interval (docs/observability.md, stage glossary)."""
+        The mesh encoders split the harvest wall into ``fetch_wait``
+        (D2H materialization, attributed per SFE stripe shard in their
+        ``last_harvest_stages``) and ``pack`` (host slice concat /
+        entropy glue); injected encoders without the split fall back to
+        whole-wall ``fetch_wait`` (docs/observability.md)."""
         return self._coord._pop_trace(self.sid, seq)
 
     def close(self) -> None:
@@ -210,10 +220,17 @@ class MeshEncodeCoordinator:
         health_sick_errors: Optional[float] = None,
         health_window_s: Optional[float] = None,
         lane_retire_s: float = 5.0,
+        sfe_shards: int = 1,
     ) -> None:
         self.profile = profile
         self.width, self.height = width, height
         self.framerate = float(framerate)
+        #: split-frame encoding (ISSUE 15, docs/scaling.md): when > 1,
+        #: every lane of this bucket is an SFE lane — one session slot
+        #: spans this many chips, each encoding a stripe band of the
+        #: same frame. The default factory decides from sfe_min_pixels;
+        #: injected-encoder harnesses pass it explicitly.
+        self.sfe_shards = max(1, int(sfe_shards))
         if enc_factory is not None:
             # injected lanes (tests, tools/swarm_run.py): no jax import,
             # capacity comes from the caller
@@ -274,6 +291,10 @@ class MeshEncodeCoordinator:
         self.migrations_blocked_total = 0
         self.lanes_built_total = 0
         self.lanes_retired_total = 0
+        #: recent harvest fetch/concat walls (ms) from the lane encoders'
+        #: last_harvest_stages — the sfe_concat_ms observability feed
+        self._fetch_ms_window: deque = deque(maxlen=128)
+        self._concat_ms_window: deque = deque(maxlen=128)
         # first lane is built eagerly so construction failures surface at
         # coordinator-build time (the server scopes those per geometry)
         if self._build_lane() is None:
@@ -284,15 +305,43 @@ class MeshEncodeCoordinator:
     @staticmethod
     def _chips_from_spec(spec: str) -> int:
         """Device count implied by a ``tpu_mesh`` spec string, computed
-        textually so injected-encoder mode never imports jax."""
+        textually so injected-encoder mode never imports jax. Malformed
+        parts are a configuration error and REJECTED — a typo'd axis
+        must not silently collapse a multi-chip slice to one chip."""
         chips = 1
         for part in str(spec or "").split(","):
-            _, _, num = part.strip().partition(":")
+            part = part.strip()
+            if not part:
+                continue
+            name, sep, num = part.partition(":")
+            if not sep or not name.strip():
+                raise ValueError(f"malformed tpu_mesh part {part!r} "
+                                 f"(want axis:size)")
             try:
                 chips *= max(1, int(num))
             except ValueError:
-                pass
+                raise ValueError(
+                    f"malformed tpu_mesh part {part!r}: size "
+                    f"{num!r} is not an integer") from None
         return chips
+
+    @staticmethod
+    def _sfe_shard_count(total_chips: int, width: int, height: int,
+                         settings) -> int:
+        """Stripe shards one frame of this geometry should span: 1 below
+        ``sfe_min_pixels`` (or on a single chip), else ``sfe_shards``
+        (0 = every chip), clamped to the largest count that tiles the
+        slice. Pure policy — unit-testable without devices."""
+        sfe_min = int(getattr(settings, "sfe_min_pixels", 0) or 0) \
+            if settings is not None else 0
+        if not sfe_min or total_chips <= 1 or width * height < sfe_min:
+            return 1
+        want = int(getattr(settings, "sfe_shards", 0) or 0) \
+            if settings is not None else 0
+        shards = max(1, min(want or total_chips, total_chips))
+        while total_chips % shards:    # largest count that tiles the slice
+            shards -= 1
+        return shards
 
     def _build_default_factory(self, mesh_spec, sessions_per_chip, width,
                                height, settings, stripe_h, profile):
@@ -300,6 +349,29 @@ class MeshEncodeCoordinator:
         from .mesh_h264 import MeshH264Encoder
 
         mesh = parse_mesh_spec(mesh_spec)
+        total = mesh.shape["session"] * mesh.shape["stripe"]
+        shards = self._sfe_shard_count(total, width, height, settings)
+        if shards > 1:
+            # SFE lane kind (ISSUE 15): this geometry's frames are too
+            # big for one chip — re-partition the slice stripe-major so
+            # one session's stripe bands shard across `shards` chips
+            # (H.264 stripes are independently decodable, so the shards
+            # run shard-local device CAVLC and the host concatenates).
+            import numpy as _np
+            from jax.sharding import Mesh as _Mesh
+
+            devs = _np.asarray(mesh.devices).reshape(-1)
+            mesh = _Mesh(devs.reshape(total // shards, shards),
+                         ("session", "stripe"))
+            self.sfe_shards = shards
+            logger.info(
+                "SFE lane geometry for %dx%d (%s): %d stripe shards "
+                "per frame, %d session slot(s) per lane axis",
+                width, height, profile, shards, total // shards)
+        # an operator-configured stripe axis (tpu_mesh "…,stripe:M") is
+        # stripe sharding too: shard-keyed faults and SFE accounting
+        # must see it even when sfe_min_pixels never fired
+        self.sfe_shards = max(self.sfe_shards, mesh.shape["stripe"])
         self.chips = mesh.shape["session"] * mesh.shape["stripe"]
         self.slots_per_lane = (
             mesh.shape["session"] * max(1, sessions_per_chip))
@@ -443,6 +515,11 @@ class MeshEncodeCoordinator:
                 "quarantined_slots": quarantined,
                 "active_sessions": len(self._sessions),
                 "lanes": len(self.lanes),
+                # SFE lanes span several chips per session slot: the
+                # admission verdict still thinks in slots (correct), but
+                # capacity consumers must see what one slot costs
+                "sfe_shards": self.sfe_shards,
+                "chips_per_slot": self.sfe_shards,
             }
 
     def _release(self, sid: int) -> None:
@@ -619,6 +696,9 @@ class MeshEncodeCoordinator:
                 "inflight_batches": sum(
                     len(ln.inflight_q) for ln in self.lanes),
                 "inflight_batches_max": self.inflight_batches_max,
+                "sfe_shards": self.sfe_shards,
+                "sfe_fetch_ms_p50": _p50(self._fetch_ms_window),
+                "sfe_concat_ms_p50": _p50(self._concat_ms_window),
                 "lane_detail": lane_detail,
             }
 
@@ -675,18 +755,44 @@ class MeshEncodeCoordinator:
                     lane.health.record_error(slot)
                     sess.inflight = max(0, sess.inflight - 1)
             raise
-        # flight-recorder intervals: the sharded harvest interleaves the
-        # D2H materialization with host assembly, so the whole wall is
-        # attributed to fetch_wait (coarser than the solo pipelines; the
-        # stage glossary in docs/observability.md documents this)
+        # flight-recorder intervals: the mesh encoders report the
+        # fetch/concat split of the harvest wall (last_harvest_stages,
+        # with per-shard fetch attribution for SFE lanes) — D2H
+        # materialization rides fetch_wait, host slice-concat/entropy
+        # glue rides pack. Encoders without the split (injected fakes)
+        # keep the coarse whole-wall fetch_wait attribution.
         t1 = time.monotonic()
-        harvest_iv = (t0, t1)
         harvest_ms = (t1 - t0) * 1000.0
+        stages = getattr(lane.enc, "last_harvest_stages", None)
+        if isinstance(stages, dict) and "fetch_ms" in stages:
+            t_split = min(t1, t0 + float(stages["fetch_ms"]) / 1000.0)
+            trace_iv = {"dispatch": dispatch_iv,
+                        "fetch_wait": (t0, t_split),
+                        "pack": (t_split, t1)}
+        else:
+            trace_iv = {"dispatch": dispatch_iv, "fetch_wait": (t0, t1)}
+        # encoder-internal stripe-job failures (whole-frame containment
+        # withheld the AU without raising) must charge the slot exactly
+        # like a harvest raise or an injected fault — otherwise a sick
+        # shard chip freezes its session forever while health records ok
+        # and quarantine/migration never fire
+        failed = getattr(lane.enc, "last_failed_sessions", None) \
+            or frozenset()
         with self._lock:
+            if isinstance(stages, dict) and "fetch_ms" in stages:
+                # under the lock: stats() sorts these windows while the
+                # worker appends — deques must not be mutated mid-iteration
+                self._fetch_ms_window.append(float(stages["fetch_ms"]))
+                self._concat_ms_window.append(
+                    float(stages.get("concat_ms", 0.0)))
             lane.inflight_q.popleft()
             for sess, slot, gen in took:
                 sess.inflight = max(0, sess.inflight - 1)
-                lane.health.record_ok(slot, harvest_ms)
+                if slot in failed:
+                    lane.slot_errors[slot] += 1
+                    lane.health.record_error(slot)
+                else:
+                    lane.health.record_ok(slot, harvest_ms)
                 if sess.closed or sess.gen != gen:
                     # released or migrated mid-flight: the old binding's
                     # pixels must not reach the (re-homed) session
@@ -695,8 +801,7 @@ class MeshEncodeCoordinator:
                 seq = sess.seq
                 sess.seq = seq + 1
                 sess.results.append((seq, out[slot]))
-                sess.traces[seq] = {"dispatch": dispatch_iv,
-                                    "fetch_wait": harvest_iv}
+                sess.traces[seq] = dict(trace_iv)
                 while len(sess.traces) > 32:
                     sess.traces.pop(next(iter(sess.traces)))
 
@@ -747,8 +852,21 @@ class MeshEncodeCoordinator:
                 for slot, sess in list(lane.sessions.items()):
                     if sess.pending is None:
                         continue
+                    keys = ()
+                    if faults is not None:
+                        keys = [f"{lane.id}:{slot}", slot]
+                        if self.sfe_shards > 1:
+                            # an SFE slot answers to its shard identities
+                            # too: a fault targeting ONE stripe shard of
+                            # the frame still drops the WHOLE frame
+                            # (whole-frame containment — a torn access
+                            # unit is never an outcome) and charges this
+                            # session's slot
+                            for k in range(self.sfe_shards):
+                                keys += [f"{lane.id}:{slot}:{k}",
+                                         f"shard:{k}"]
                     if faults is not None and faults.should_fire_for(
-                            "mesh.slot_raise", f"{lane.id}:{slot}", slot):
+                            "mesh.slot_raise", *keys):
                         # slot-scoped fault: charge THIS slot and drop its
                         # frame; cohabiting sessions' tick proceeds — a
                         # slot failure must never become a mesh failure

@@ -10,6 +10,7 @@ is a psum over both axes.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass
 from typing import Any, List, Optional, Tuple
 
@@ -25,6 +26,43 @@ if hasattr(jax, "shard_map"):
     shard_map = jax.shard_map
 else:  # pragma: no cover - older runtimes
     from jax.experimental.shard_map import shard_map
+
+
+def fetch_sharded_prefix(prefix):
+    """Materialize an eagerly-fetching sharded device array shard by
+    shard, attributing the D2H wall to each stripe-axis block.
+
+    Returns ``(host, per_shard_ms)``: the assembled host ndarray and a
+    map of stripe-axis block index (dim 1 of the array) to the host
+    milliseconds spent blocked on that shard's transfer — the flight
+    recorder's per-shard fetch attribution for split-frame encoding
+    (ISSUE 15). Several sessions' shards on the same stripe block fold
+    to the max (the gating wall). Falls back to one whole-array gather
+    when shards are not addressable from this process."""
+    try:
+        if not getattr(prefix, "is_fully_addressable", True):
+            # a process-spanning mesh would leave the remote shards'
+            # regions of the np.empty buffer as garbage — fall through
+            # to the whole-array gather, which fails loudly instead
+            raise ValueError("prefix not fully addressable")
+        shards = list(prefix.addressable_shards)
+        if not shards:
+            raise ValueError("no addressable shards")
+        host = np.empty(prefix.shape, dtype=prefix.dtype)
+        per_shard: dict = {}
+        for sh in shards:
+            t0 = time.perf_counter()
+            host[sh.index] = np.asarray(sh.data)
+            ms = (time.perf_counter() - t0) * 1000.0
+            k = 0
+            if len(sh.index) > 1 and isinstance(sh.index[1], slice):
+                k = int(sh.index[1].start or 0)
+            per_shard[k] = max(per_shard.get(k, 0.0), ms)
+        return host, per_shard
+    except Exception:
+        t0 = time.perf_counter()
+        host = np.asarray(prefix)
+        return host, {0: (time.perf_counter() - t0) * 1000.0}
 
 
 def make_mesh(
@@ -357,6 +395,9 @@ class MeshStripeEncoder:
         #: adaptive D2H prefix (words per (session, shard) fetched besides
         #: metadata); a miss costs one extra read of the missing slice
         self._guess = self._packer.bucket_words(8192)
+        #: fetch/concat split of the latest harvest wall, with per-shard
+        #: fetch attribution (the coordinator's flight-recorder feed)
+        self.last_harvest_stages: Optional[dict] = None
 
     # -- control -----------------------------------------------------------
 
@@ -460,10 +501,16 @@ class MeshStripeEncoder:
 
     def harvest(self, p: "_MeshPending") -> Tuple[List[List], np.ndarray]:
         """Complete one dispatched step: returns (stripes_per_session,
-        session_coded_bytes). Must be called in dispatch order."""
+        session_coded_bytes). Must be called in dispatch order.
+
+        Sets :attr:`last_harvest_stages` — the fetch/concat split of the
+        harvest wall with per-stripe-shard fetch attribution — which the
+        coordinator folds into each frame's flight-recorder span."""
         from ..encoder.jpeg import StripeOutput, split_meta
 
-        host = np.asarray(p.prefix)
+        t_h0 = time.perf_counter()
+        host, per_shard_ms = fetch_sharded_prefix(p.prefix)
+        fetch_ms = sum(per_shard_ms.values())
         head = self._mw + 1
 
         damaged = np.zeros((self.n_sessions, self.n_stripes), bool)
@@ -516,7 +563,11 @@ class MeshStripeEncoder:
                     nbytes, base, ovf = metas[(n, k)]
                     total = int(base[-1]) + (int(nbytes[-1]) + 3) // 4
                     if (n, k) in refetch:
+                        t_rf = time.perf_counter()
                         words = np.asarray(refetch[(n, k)])
+                        rf_ms = (time.perf_counter() - t_rf) * 1000.0
+                        fetch_ms += rf_ms
+                        per_shard_ms[k] = per_shard_ms.get(k, 0.0) + rf_ms
                     else:
                         words = host[n, k, head:head + total]
                     stripes += self._shard_stripes(
@@ -526,6 +577,14 @@ class MeshStripeEncoder:
 
         self._guess = max(self._packer.bucket_words(max(max_total * 2, 8192)),
                           self._guess // 2)
+        total_ms = (time.perf_counter() - t_h0) * 1000.0
+        self.last_harvest_stages = {
+            "fetch_ms": fetch_ms,
+            "concat_ms": max(0.0, total_ms - fetch_ms),
+            "per_shard_fetch_ms": [
+                round(per_shard_ms.get(k, 0.0), 3)
+                for k in range(self.n_stripe_ax)],
+        }
         return out, session_bytes
 
     def encode_frames(self, frames) -> Tuple[List[List], np.ndarray]:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -39,7 +40,7 @@ from ..encoder import device_cavlc as dcav
 from ..encoder import h264_device as dev
 from ..encoder.h264 import H264Stripe, encode_picture_nals_np, make_pps, make_sps
 from ..encoder.h264 import _entropy_pool
-from .mesh import shard_map
+from .mesh import fetch_sharded_prefix, shard_map
 
 logger = logging.getLogger("selkies_tpu.parallel.h264")
 
@@ -236,7 +237,8 @@ class MeshH264Encoder:
             self._fixed_bytes = dcav.HEAD_BYTES * self.s_local
             self._buf_bytes = self._fixed_bytes \
                 + self.s_local * self._cavlc_msb
-            self._prefix = self._bucket(self._fixed_bytes + (16 << 10))
+            self._prefix = self._bucket(
+                self._fixed_bytes + self.s_local * (4 << 10))
         else:
             self._cavlc_msb = 0
             self._fixed_bytes = 4 * self.s_local \
@@ -246,7 +248,8 @@ class MeshH264Encoder:
             #: per-(session, shard) fetch prefix over the content-
             #: compacted buffer (same layout as the solo encoder); an
             #: undershoot falls back to flat16 rows and grows the bucket
-            self._prefix = self._bucket(self._fixed_bytes + (32 << 10))
+            self._prefix = self._bucket(
+                self._fixed_bytes + self.s_local * (8 << 10))
 
         self._steps: Dict[Tuple[bool, int], Any] = {}
 
@@ -272,6 +275,27 @@ class MeshH264Encoder:
         self._last_host = np.zeros(
             (n_sessions, self.pad_h, self.pad_w, 3), np.uint8)
         self._sps_pps: Dict[int, bytes] = {}
+        #: fetch/concat split of the latest harvest wall with per-shard
+        #: fetch attribution (the coordinator's flight-recorder feed)
+        self.last_harvest_stages: Optional[dict] = None
+        #: stripes recovered through the flat16 host coder (overflow /
+        #: prefix undershoot; IDR resyncs excluded) — observability
+        self.host_fallback_stripes_total = 0
+        #: sessions whose frame was withheld by whole-frame containment:
+        #: in-flight successor ticks predicted off the withheld frame's
+        #: references are withheld too, until the full-IDR resync tick
+        self._withheld = np.zeros(n_sessions, bool)
+        #: session indices whose stripe jobs FAILED in the latest
+        #: harvest (not containment carry-over) — the coordinator charges
+        #: these slots' health so repeated encoder-internal failures walk
+        #: the slot into quarantine + migration like injected faults
+        self.last_failed_sessions: frozenset = frozenset()
+
+    @property
+    def n_shards(self) -> int:
+        """Chips one frame's stripe bands are sharded across (the SFE
+        stripe axis; 1 = whole frame on one chip)."""
+        return self.n_stripe_ax
 
     # -- control -----------------------------------------------------------
 
@@ -287,6 +311,7 @@ class MeshH264Encoder:
         self.force_keyframe(session)
         self._frame_num[session] = 0
         self._last_host[session] = 0
+        self._withheld[session] = False
         put = functools.partial(jax.device_put)
         for name in ("_prev_y", "_prev_cb", "_prev_cr",
                      "_ref_y", "_ref_cb", "_ref_cr"):
@@ -297,10 +322,18 @@ class MeshH264Encoder:
     # -- helpers -----------------------------------------------------------
 
     def _bucket(self, nbytes: int) -> int:
-        n = 4096
-        while n < nbytes:
-            n <<= 1
-        return min(n, self._buf_bytes)
+        """Fetch-prefix bound quantized PER STRIPE: the payload share
+        above the fixed head rounds up to s_local × a power-of-two
+        per-stripe budget (≥1 KB). The set of compiled prefix shapes is
+        then a function of per-stripe content alone — growing the SFE
+        shard count shrinks s_local instead of multiplying distinct
+        executables, and every lane of a bucket walks the same ladder
+        (ISSUE 15)."""
+        per = 1 << 10
+        need = max(0, int(nbytes) - self._fixed_bytes)
+        while per * self.s_local < need:
+            per <<= 1
+        return min(self._fixed_bytes + per * self.s_local, self._buf_bytes)
 
     def _step_for(self, with_idr: bool, prefix: int):
         key = (with_idr, prefix)
@@ -336,11 +369,20 @@ class MeshH264Encoder:
     def dispatch(self, frames) -> _MeshH264Pending:
         """One sharded step for all sessions; pair with :meth:`harvest`.
 
-        ``frames``: [N, H, W, 3] array or length-N sequence (None entries
-        re-present the previous frame; damage gating suppresses them).
+        ``frames``: [N, H, W, 3] array, a device-resident pre-padded jnp
+        batch (bench/synthetic sources; bypasses the idle re-present
+        cache like MeshStripeEncoder's), or a length-N sequence (None
+        entries re-present the previous frame; damage gating suppresses
+        them).
         """
         reuse_prev = np.zeros(self.n_sessions, bool)
-        if isinstance(frames, np.ndarray) and frames.ndim == 4:
+        batch: Any = self._last_host
+        if isinstance(frames, jnp.ndarray):
+            want = (self.n_sessions, self.pad_h, self.pad_w, 3)
+            if frames.shape != want:
+                raise ValueError(f"device batch must be pre-padded to {want}")
+            batch = frames
+        elif isinstance(frames, np.ndarray) and frames.ndim == 4:
             for n in range(self.n_sessions):
                 self._last_host[n] = self._pad(np.asarray(frames[n], np.uint8))
         else:
@@ -349,6 +391,12 @@ class MeshH264Encoder:
                     reuse_prev[n] = True
                 else:
                     self._last_host[n] = self._pad(np.asarray(f, np.uint8))
+
+        # a withheld session's client never received the content already
+        # sitting in _last_host (whole-frame containment dropped it), so
+        # an idle re-present is NOT a no-op for it: run the armed
+        # full-frame IDR resync now instead of waiting for fresh damage
+        reuse_prev &= ~self._withheld
 
         idr = self._need_idr & ~reuse_prev[:, None]
         paint = (self.use_paint_over_quality
@@ -362,7 +410,7 @@ class MeshH264Encoder:
 
         qp_arr = np.where(paint, self.paint_over_qp, self.qp)
         fn = self._step_for(bool(idr.any()), self._prefix)
-        frames_d = jax.device_put(jnp.asarray(self._last_host),
+        frames_d = jax.device_put(jnp.asarray(batch),
                                   self._frame_sharding)
         paint_d = jax.device_put(jnp.asarray(paint.astype(np.int32)),
                                  self._plane_sharding)
@@ -387,8 +435,16 @@ class MeshH264Encoder:
     def harvest(self, p: _MeshH264Pending
                 ) -> Tuple[List[List[H264Stripe]], np.ndarray]:
         """Entropy-code one dispatched tick. Returns (stripes per session,
-        coded bytes per session). Must be called in dispatch order."""
-        host = np.asarray(p.prefix)          # [N, stripe_ax, prefix]
+        coded bytes per session). Must be called in dispatch order.
+
+        Sets :attr:`last_harvest_stages` — the fetch/concat split of the
+        harvest wall with per-stripe-shard fetch attribution — which the
+        coordinator folds into each frame's flight-recorder span."""
+        t_h0 = time.perf_counter()
+        # [N, stripe_ax, prefix]: materialized shard by shard so the D2H
+        # wall is attributable per SFE stripe shard
+        host, per_shard_ms = fetch_sharded_prefix(p.prefix)
+        fetch_ms = sum(per_shard_ms.values())
         S, sl = self.n_stripes, self.s_local
         CELL = dev.CELL
         cavlc = self.entropy == "device"
@@ -450,6 +506,9 @@ class MeshH264Encoder:
                         self._prefix = self._bucket(needed + needed // 2)
                         grew = True
         host_path = ovf | (cavlc & p.idr)
+        # overflow / prefix-undershoot stripes recovered through the
+        # flat16 host coder (IDR resyncs are by-construction, not faults)
+        self.host_fallback_stripes_total += int((ovf & emit).sum())
         exact: Dict[Tuple[int, int], Any] = {}
         for n in range(self.n_sessions):
             for g in range(S):
@@ -476,7 +535,11 @@ class MeshH264Encoder:
                                  ("bits", pb, nbits)))
                     continue
                 if host_path[n, g]:
+                    t_rf = time.perf_counter()
                     row = np.asarray(exact[(n, g)]).astype(np.int32)
+                    rf_ms = (time.perf_counter() - t_rf) * 1000.0
+                    fetch_ms += rf_ms
+                    per_shard_ms[k] = per_shard_ms.get(k, 0.0) + rf_ms
                 else:
                     bitmap = host[n, k, 4 * sl:self._fixed_bytes] \
                         .reshape(sl, self._n_cells // 8)[s]
@@ -524,14 +587,40 @@ class MeshH264Encoder:
         payloads = list(_entropy_pool().map(safe_one, jobs)) \
             if len(jobs) > 1 else [safe_one(j) for j in jobs]
 
+        # whole-frame containment (ISSUE 15): a failed stripe job must
+        # never tear the access unit. Sibling stripes of the same frame
+        # are withheld WITH it — their device reference planes already
+        # advanced, so emitting them while skipping the failed one would
+        # silently drift every later P frame — and the whole session
+        # resyncs with a full IDR on its next tick instead. Successor
+        # ticks already in flight when the failure surfaces predicted
+        # off the withheld references too, so the session STAYS withheld
+        # until the tick that was dispatched as a full-frame IDR.
+        prev_withheld = self._withheld.copy()
+        failed_sessions = set()
+        for job, payload in zip(jobs, payloads):
+            if isinstance(payload, Exception):
+                n, g = job[0], job[1]
+                logger.error("mesh CAVLC failed for session %d stripe %d; "
+                             "frame withheld, forcing whole-frame IDR "
+                             "resync", n, g, exc_info=payload)
+                failed_sessions.add(n)
+        for n in failed_sessions:
+            self._need_idr[n] = True
+            self._withheld[n] = True
+        self.last_failed_sessions = frozenset(failed_sessions)
+        # the resync tick (dispatched all-IDR) releases the withhold —
+        # unless it failed too, in which case the next one re-arms
+        release = prev_withheld & p.idr.all(axis=1)
+        for n in failed_sessions:
+            release[n] = False
+        self._withheld &= ~release
+
         out: List[List[H264Stripe]] = [[] for _ in range(self.n_sessions)]
         coded = np.zeros(self.n_sessions, np.int64)
         for job, payload in zip(jobs, payloads):
             n, g, is_key, qp, _ = job
-            if isinstance(payload, Exception):
-                logger.error("mesh CAVLC failed for session %d stripe %d; "
-                             "forcing IDR resync", n, g, exc_info=payload)
-                self._need_idr[n, g] = True
+            if n in failed_sessions or (prev_withheld[n] and not release[n]):
                 continue
             y0 = g * self.stripe_h
             h = min(self.stripe_h, self.height - y0)
@@ -550,6 +639,14 @@ class MeshH264Encoder:
             out[n].append(H264Stripe(
                 y_start=y0, width=self.width, height=h,
                 annexb=payload, is_key=is_key))
+        total_ms = (time.perf_counter() - t_h0) * 1000.0
+        self.last_harvest_stages = {
+            "fetch_ms": fetch_ms,
+            "concat_ms": max(0.0, total_ms - fetch_ms),
+            "per_shard_fetch_ms": [
+                round(per_shard_ms.get(k, 0.0), 3)
+                for k in range(self.n_stripe_ax)],
+        }
         return out, coded
 
     def encode_frames(self, frames) -> Tuple[List[List[H264Stripe]],

@@ -92,21 +92,35 @@ class FakeStripe:
 
 
 class FakeMeshEncoder:
-    """Mesh-encoder lookalike: one tiny stripe per submitted session.
+    """Mesh-encoder lookalike: one tiny stripe per submitted session
+    (``n_shards`` of them for an SFE-shaped lane — the torn-access-unit
+    tests assert a harvested frame always carries ALL of its shard
+    stripes or none).
 
     ``fail_dispatches`` fails that many whole dispatch calls (a lane-level
     fault); slot-scoped faults are injected upstream of dispatch via the
-    coordinator's ``mesh.slot_raise`` point, not here.
+    coordinator's ``mesh.slot_raise`` point, not here. Harvests report a
+    ``last_harvest_stages`` fetch/concat split like the real mesh
+    encoders so the coordinator's flight-recorder attribution is
+    exercised device-free.
     """
 
     def __init__(self, n_sessions: int, width: int = 0, height: int = 0,
-                 fail_dispatches: int = 0) -> None:
+                 fail_dispatches: int = 0, n_shards: int = 1) -> None:
         self.n_sessions = int(n_sessions)
         self.width, self.height = width, height
         self.fail_dispatches = int(fail_dispatches)
+        self.n_shards = max(1, int(n_shards))
         self.dispatches = 0
         self.resets: List[int] = []
         self.keyframes: List[int] = []
+        self.last_harvest_stages = None
+        #: tests add session indices here to model encoder-INTERNAL
+        #: stripe-job failures (whole-frame containment: harvest returns
+        #: an empty AU for them, nothing raises) — reported through
+        #: last_failed_sessions so the coordinator charges slot health
+        self.fail_sessions: set = set()
+        self.last_failed_sessions: frozenset = frozenset()
 
     def reset_session(self, session: int) -> None:
         self.resets.append(session)
@@ -125,6 +139,17 @@ class FakeMeshEncoder:
         return True
 
     def harvest(self, pending):
-        out = [[FakeStripe(height=16)] if took else [] for took in pending]
-        session_bytes = [len(s[0].jpeg) if s else 0 for s in out]
+        out = [
+            [FakeStripe(y_start=16 * k, height=16)
+             for k in range(self.n_shards)] if took else []
+            for took in pending]
+        failed = {n for n, took in enumerate(pending)
+                  if took and n in self.fail_sessions}
+        for n in failed:
+            out[n] = []                      # withheld whole, never torn
+        self.last_failed_sessions = frozenset(failed)
+        session_bytes = [sum(len(st.jpeg) for st in s) for s in out]
+        self.last_harvest_stages = {
+            "fetch_ms": 0.2, "concat_ms": 0.1,
+            "per_shard_fetch_ms": [0.2 / self.n_shards] * self.n_shards}
         return out, session_bytes

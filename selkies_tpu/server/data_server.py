@@ -1782,12 +1782,15 @@ class DataStreamingServer:
                 # check the server's injector at the coordinator's sites
                 coord.faults = self.faults
                 self.mesh_coordinators[geom] = coord
+                sfe_n = int(getattr(coord, "sfe_shards", 1) or 1)
                 logger.info(
                     "mesh batching: %s → %s session slots/lane (max %s "
-                    "lanes) at %dx%d (bucket %d)", spec,
+                    "lanes) at %dx%d (bucket %d)%s", spec,
                     getattr(coord, "slots_per_lane", "?"),
                     getattr(coord, "max_lanes", "?"), st.width, st.height,
-                    len(self.mesh_coordinators))
+                    len(self.mesh_coordinators),
+                    f" — SFE lanes, {sfe_n} stripe shards/frame"
+                    if sfe_n > 1 else "")
             except Exception:
                 logger.exception(
                     "mesh coordinator for %dx%d (%s) unavailable; that "
@@ -1949,6 +1952,10 @@ class DataStreamingServer:
                     cs.get("worker_restarts_total", 0),
                 "inflight_batches": cs.get("inflight_batches", 0),
                 "migrations_total": cs.get("migrations_total", 0),
+                # SFE lanes (ISSUE 15): chips one frame spans, and the
+                # host-side slice-concat share of the harvest wall
+                "sfe_shards": cs.get("sfe_shards", 1),
+                "sfe_concat_ms_p50": cs.get("sfe_concat_ms_p50", 0.0),
                 "lane_detail": cs.get("lane_detail", []),
             }
         return pack_system_health(displays, mesh=mesh or None)
@@ -2138,9 +2145,21 @@ class DataStreamingServer:
                     net["mesh_migrations_total"] = sum(
                         getattr(coord, "migrations_total", 0)
                         for coord in self.mesh_coordinators.values())
+                    # one stats() snapshot per coordinator per tick (it
+                    # takes the scheduler lock): SFE + gauges share it
+                    coord_stats = [c.stats() for c in
+                                   self.mesh_coordinators.values()]
+                    # SFE lanes (ISSUE 15): shard count + slice-concat
+                    # wall ride the stats feed and the gauges
+                    sfe_stats = [cs for cs in coord_stats
+                                 if cs.get("sfe_shards", 1) > 1]
+                    if sfe_stats:
+                        net["mesh_sfe_shards"] = max(
+                            cs["sfe_shards"] for cs in sfe_stats)
+                        net["mesh_sfe_concat_ms_p50"] = max(
+                            cs.get("sfe_concat_ms_p50", 0.0)
+                            for cs in sfe_stats)
                     if self.metrics is not None:
-                        coord_stats = [c.stats() for c in
-                                       self.mesh_coordinators.values()]
                         self.metrics.set_mesh_health(
                             active_sessions=net["mesh_sessions"],
                             lanes=net.get("mesh_lanes", 0),
@@ -2155,6 +2174,10 @@ class DataStreamingServer:
                             quarantined=net.get(
                                 "mesh_quarantined_slots", 0),
                             migrations=net["mesh_migrations_total"])
+                        self.metrics.set_sfe_health(
+                            shards=net.get("mesh_sfe_shards", 0),
+                            concat_ms_p50=net.get(
+                                "mesh_sfe_concat_ms_p50", 0.0))
                 edge = self.edge_stats
                 if (edge["protocol_errors"] or edge["rate_limited"]
                         or edge["sessions_rejected"]

@@ -406,6 +406,16 @@ SETTING_DEFINITIONS: List[Spec] = [
              "lane capacity, serve the overflow display with a solo "
              "encoder pipeline (pre-scheduler behavior) instead of "
              "queue/shed admission verdicts.", server_only=True),
+    IntSpec("sfe_min_pixels", 8294400, "Split-frame encoding threshold: a "
+            "display whose width x height crosses this claims a "
+            "stripe-sharded SFE lane spanning several chips (one frame's "
+            "stripe bands encoded in parallel over the ICI mesh) instead "
+            "of a one-chip session slot. Default 3840x2160; 0 disables "
+            "SFE.", server_only=True),
+    IntSpec("sfe_shards", 0, "Chips one SFE frame is sharded across "
+            "(stripe mesh axis). 0 = auto: every chip of the tpu_mesh "
+            "slice; clamped to the largest count that tiles the slice.",
+            server_only=True),
 
     # --- TPU-native additions (server-only) ---
     IntSpec("tpu_stripe_height", 64, "Encoder stripe height in rows (multiple of 16).",

@@ -95,6 +95,51 @@ def test_h264_p_frames_bit_exact_over_gop():
         d.close()
 
 
+@pytest.mark.slow  # ~50 s (a fresh 2-shard SPMD compile); transitively
+# covered in tier 1 — test_parallel pins the SFE bytes to the solo
+# encoder's, whose output the tier-1 conformance tests above decode
+def test_sfe_multi_shard_stream_decodes_bit_exact():
+    """Split-frame encoding (ISSUE 15): one frame's stripe bands encoded
+    on DIFFERENT chips must decode in libavcodec bit-exact with the
+    encoder's own sharded reconstruction planes — IDR then P — i.e. the
+    host-concatenated access unit is a conformant stream, not merely
+    byte-stable."""
+    import jax
+
+    from selkies_tpu.parallel import parse_mesh_spec
+    from selkies_tpu.parallel.mesh_h264 import MeshH264Encoder
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    w, h, sh = 112, 64, 32                    # 2 stripes, one per shard
+    mesh = parse_mesh_spec("session:1,stripe:2", jax.devices()[:2])
+    enc = MeshH264Encoder(mesh, 1, w, h, stripe_h=sh, qp=28, search=4,
+                          me="xla")
+    decoders = {i * sh: conformance.ConformanceDecoder("h264", max_dim=256)
+                for i in range(h // sh)}
+    for t in range(3):
+        frame = _smooth_frame(h, w, seed=3, shift=3 * t)
+        (stripes,), _ = enc.encode_frames([frame])
+        assert len(stripes) == h // sh, f"t={t}: torn access unit"
+        ref_y = np.asarray(enc._ref_y)[0]
+        ref_cb = np.asarray(enc._ref_cb)[0]
+        ref_cr = np.asarray(enc._ref_cr)[0]
+        for s in stripes:
+            got = decoders[s.y_start].decode(s.annexb)
+            assert got is not None, f"t={t} stripe {s.y_start}: no frame"
+            dy, du, dv = got
+            y0 = s.y_start
+            np.testing.assert_array_equal(
+                dy, ref_y[y0:y0 + s.height, :w],
+                err_msg=f"t={t} stripe {y0} luma mismatch")
+            np.testing.assert_array_equal(
+                du, ref_cb[y0 // 2:(y0 + s.height) // 2, :w // 2])
+            np.testing.assert_array_equal(
+                dv, ref_cr[y0 // 2:(y0 + s.height) // 2, :w // 2])
+    for d in decoders.values():
+        d.close()
+
+
 def test_h264_quality_reasonable():
     """Decoded pixels must resemble the source (catches e.g. swapped
     chroma or broken prediction that bit-exactness alone can't: if recon

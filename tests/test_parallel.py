@@ -276,6 +276,136 @@ def test_mesh_h264_idle_keyframe_and_reset(mesh):
     assert np.asarray(menc._ref_y)[0].any()
 
 
+# ------------------------------------------------------------- SFE (ISSUE 15)
+# Split-frame encoding: ONE session's frame stripe-sharded across every
+# chip of the mesh. The concatenated multi-shard access unit must be
+# byte-identical to the single-chip encode — IDR, P, and the
+# overflow→flat16 fallback stripes — and a failed stripe job must never
+# tear the access unit.
+
+
+@pytest.fixture(scope="module")
+def sfe_mesh():
+    from selkies_tpu.parallel import parse_mesh_spec
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    return parse_mesh_spec("session:1,stripe:4", jax.devices()[:4])
+
+
+def test_sfe_concat_bit_exact_and_never_torn(mesh, monkeypatch):
+    """Multi-shard SFE vs the solo single-chip oracle over an IDR + P +
+    still + partial-change sequence: per-stripe bytes AND the
+    concatenated access unit must match, and the harvest must attribute
+    per-shard fetch walls. Then whole-frame containment on the SAME
+    encoder: one stripe job failing mid-harvest must withhold the WHOLE
+    frame — sibling stripes' device references already advanced, so
+    emitting them would drift every later P frame — and resync with a
+    full IDR next tick.
+
+    Runs on the module mesh (stripe axis 2) with the exact encoder
+    geometry test_mesh_h264_matches_solo already compiled, so the SPMD
+    programs come from the in-process compile cache — tier-1 pays for
+    the containment coverage, not a duplicate ~60 s compile; the wider
+    4-shard fan-out stays covered by the slow-marked overflow +
+    conformance tests on sfe_mesh."""
+    from selkies_tpu.encoder.h264 import H264StripeEncoder
+    from selkies_tpu.parallel import mesh_h264 as m
+    from selkies_tpu.parallel.mesh_h264 import MeshH264Encoder
+
+    seq = _h264_seq(np.random.default_rng(300), 5)
+    menc = MeshH264Encoder(mesh, N_SESSIONS, W, H, stripe_h=STRIPE_H,
+                           paint_over_trigger_frames=2, me="xla")
+    solo = H264StripeEncoder(W, H, stripe_height=STRIPE_H,
+                             paint_over_trigger_frames=2)
+    assert menc.n_shards == 2
+    idle = [None] * (N_SESSIONS - 1)            # single-session SFE drive
+
+    for t, frame in enumerate(seq):
+        mesh_out, coded = menc.encode_frames([frame] + idle)
+        solo_out = solo.encode_frame(frame)
+        assert [(s.y_start, s.is_key) for s in mesh_out[0]] == \
+            [(s.y_start, s.is_key) for s in solo_out], f"frame {t}"
+        cat_mesh = b"".join(s.annexb for s in mesh_out[0])
+        cat_solo = b"".join(s.annexb for s in solo_out)
+        assert cat_mesh == cat_solo, f"frame {t} access unit differs"
+    st = menc.last_harvest_stages
+    assert st is not None
+    assert len(st["per_shard_fetch_ms"]) == 2
+    assert st["concat_ms"] >= 0.0
+
+    # --- whole-frame containment: no torn access unit, ever -----------
+    real = m.dcav.assemble_p_slice
+    fails = {"n": 0}
+
+    def fail_once(*a, **kw):
+        if fails["n"] == 0:
+            fails["n"] += 1
+            raise RuntimeError("injected stripe entropy failure")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(m.dcav, "assemble_p_slice", fail_once)
+    pa = menc.dispatch([np.roll(seq[-1], 4, axis=0)] + idle)
+    pb = menc.dispatch([np.roll(seq[-1], 8, axis=0)] + idle)  # successor
+    out1, coded1 = menc.harvest(pa)             # stripe job fails here
+    assert out1[0] == []                        # withheld, not torn
+    assert int(coded1[0]) == 0
+    assert menc._need_idr[0].all()              # full resync armed
+    monkeypatch.setattr(m.dcav, "assemble_p_slice", real)
+    # the successor was dispatched as P BEFORE the failure surfaced: its
+    # prediction chain consumed the withheld frame's references, so it
+    # must be withheld too — never a client frame predicted off pixels
+    # the client never received
+    out_b, _ = menc.harvest(pb)
+    assert out_b[0] == []
+    out2, _ = menc.encode_frames([np.roll(seq[-1], 12, axis=0)] + idle)
+    assert len(out2[0]) == H // STRIPE_H
+    assert all(s.is_key for s in out2[0])       # clean full IDR AU
+
+    # --- idle sessions must still resync: the withheld frame's content
+    # never reached the client, so a None re-present is NOT a no-op for
+    # a withheld session — the armed full-frame IDR runs anyway instead
+    # of deferring until fresh damage (which may never come)
+    fails["n"] = 0
+    monkeypatch.setattr(m.dcav, "assemble_p_slice", fail_once)
+    out3, _ = menc.encode_frames([np.roll(seq[-1], 16, axis=0)] + idle)
+    assert out3[0] == []                        # withheld again
+    monkeypatch.setattr(m.dcav, "assemble_p_slice", real)
+    out4, _ = menc.encode_frames([None] + idle)  # idle tick
+    assert len(out4[0]) == H // STRIPE_H        # full IDR resync anyway
+    assert all(s.is_key for s in out4[0])
+
+
+@pytest.mark.slow  # ~44 s (a fresh SPMD compile); the flat16 recovery
+# path itself is tier-1-covered: the concat test's IDR stripes recover
+# through the same exact[(n,g)] flat16 route (host_path = ovf | idr),
+# and the device-side ovf FLAG is pinned by test_device_cavlc — this
+# pins their end-to-end combination on the SFE mesh
+def test_sfe_overflow_flat16_fallback_bit_exact(sfe_mesh):
+    """Pathological stripes overflow the device CAVLC budget and recover
+    through the exact flat16 host coder — on the SFE mesh this fallback
+    must stay byte-identical to the solo encoder taking the same
+    fallback (shrunken budget forces it deterministically)."""
+    from selkies_tpu.encoder.h264 import H264StripeEncoder
+    from selkies_tpu.parallel.mesh_h264 import MeshH264Encoder
+
+    rng = np.random.default_rng(17)
+    menc = MeshH264Encoder(sfe_mesh, 1, W, H, stripe_h=STRIPE_H, me="xla",
+                           search=4)
+    solo = H264StripeEncoder(W, H, stripe_height=STRIPE_H, search=4)
+    # identical tiny per-stripe budgets BEFORE the first (lazy) step
+    # build: full-noise P frames then exceed it and take the flat16 path
+    menc._cavlc_msb = 64
+    solo._cavlc_msb = 64
+    for t in range(2):
+        frame = rng.integers(0, 256, (H, W, 3), dtype=np.uint8)
+        mesh_out, _ = menc.encode_frames([frame])
+        solo_out = solo.encode_frame(frame)
+        assert b"".join(s.annexb for s in mesh_out[0]) == \
+            b"".join(s.annexb for s in solo_out), f"frame {t}"
+    assert menc.host_fallback_stripes_total > 0
+
+
 @pytest.mark.slow  # ~43 s; transitively covered in tier 1 —
 # test_mesh_h264_matches_solo pins mesh bytes to the solo encoder's, and
 # test_conformance decodes the solo output in libavcodec

@@ -339,6 +339,167 @@ def test_submit_seq_accounts_for_inflight_window():
 
 
 # ---------------------------------------------------------------------------
+# SFE lanes (ISSUE 15): stripe-sharded sessions in the scheduler
+
+
+def test_chips_from_spec_parses_full_form_and_rejects_malformed():
+    """The textual device-count parse honors session:N,stripe:M and
+    REJECTS malformed parts instead of silently collapsing a multi-chip
+    slice to one chip."""
+    assert MeshEncodeCoordinator._chips_from_spec("session:2,stripe:3") == 6
+    assert MeshEncodeCoordinator._chips_from_spec("session:8") == 8
+    assert MeshEncodeCoordinator._chips_from_spec("") == 1
+    assert MeshEncodeCoordinator._chips_from_spec(" session:2 , ") == 2
+    for bad in ("session:banana", "4", "session", "session:2,oops"):
+        with pytest.raises(ValueError):
+            MeshEncodeCoordinator._chips_from_spec(bad)
+
+
+def test_sfe_shard_count_policy():
+    """Pure SFE sizing policy: below sfe_min_pixels or on one chip a
+    session stays solo-slotted; above it the frame spans sfe_shards
+    chips (0 = all), clamped to a count that tiles the slice."""
+    from types import SimpleNamespace as NS
+
+    f = MeshEncodeCoordinator._sfe_shard_count
+    fourk = NS(sfe_min_pixels=3840 * 2160, sfe_shards=0)
+    assert f(4, 1920, 1080, fourk) == 1          # below the threshold
+    assert f(1, 3840, 2160, fourk) == 1          # single chip: no SFE
+    assert f(4, 3840, 2160, fourk) == 4          # auto: every chip
+    assert f(8, 7680, 4320, fourk) == 8          # 8K spans the slice too
+    assert f(4, 3840, 2160,
+             NS(sfe_min_pixels=3840 * 2160, sfe_shards=3)) == 2
+    assert f(4, 3840, 2160, NS(sfe_min_pixels=0, sfe_shards=0)) == 1
+    assert f(4, 3840, 2160, None) == 1
+
+
+def make_sfe_coord(n_shards=4, max_lanes=2, encs=None, sick_errors=3):
+    def factory(n):
+        enc = FakeMeshEncoder(n, n_shards=n_shards)
+        if encs is not None:
+            encs.append(enc)
+        return enc
+
+    return MeshEncodeCoordinator(
+        f"session:{n_shards}", 1, 3840, 2160, enc_factory=factory,
+        slots_per_lane=1, max_lanes=max_lanes, framerate=200.0,
+        health_sick_errors=sick_errors, health_window_s=30.0,
+        lane_retire_s=5.0, sfe_shards=n_shards)
+
+
+def test_sfe_shard_fault_contains_whole_frame_and_migrates():
+    """A mesh.slot_raise targeting ONE stripe shard of an SFE session
+    must degrade that SESSION — whole-frame containment (every
+    delivered harvest carries ALL shard stripes, never a torn access
+    unit), quarantine + live migration on repeats — while the
+    neighbouring SFE lane keeps streaming."""
+    coord = make_sfe_coord(n_shards=4, max_lanes=3)
+    coord.faults = FaultInjector()
+    try:
+        victim = coord.acquire(3840, 2160)
+        cohab = coord.acquire(3840, 2160)        # second SFE lane
+        cap = coord.capacity()
+        assert cap["sfe_shards"] == 4 and cap["chips_per_slot"] == 4
+        lane0, slot0 = victim.lane_id, victim.slot
+        # target shard 2 of the victim's slot, nobody else
+        coord.faults.arm("mesh.slot_raise", times=4,
+                         arg=f"{lane0}:{slot0}:2")
+        got = {0: [], 1: []}
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and coord.migrations_total < 1:
+            for i, f in enumerate((victim, cohab)):
+                f.try_submit(b"frame")
+                got[i] += f.poll()
+            time.sleep(0.005)
+        st = coord.stats()
+        assert st["migrations_total"] == 1       # session, not shard, moved
+        assert st["quarantined_total"] == 1
+        assert st["slot_faults_total"] >= 3
+        assert victim.lane_id != lane0
+        assert victim.consume_migration() is True
+        assert len(got[1]) > 0                   # cohabitant kept flowing
+        # whole-frame containment: every delivered frame carries ALL
+        # four shard stripes — a dropped tick yields nothing, never part
+        for i in got:
+            for _seq, stripes in got[i]:
+                assert len(stripes) == 4, "torn SFE access unit"
+        deadline = time.monotonic() + 1.0
+        n0 = len(got[0])
+        while time.monotonic() < deadline and len(got[0]) == n0:
+            victim.try_submit(b"frame")
+            got[0] += victim.poll()
+            time.sleep(0.005)
+        assert len(got[0]) > n0                  # victim streams again
+        assert coord.verify_slot_accounting() == []
+    finally:
+        coord.stop()
+
+
+def test_encoder_internal_failure_charges_slot_and_migrates():
+    """A stripe-job failure INSIDE the encoder's harvest (whole-frame
+    containment withholds the AU; harvest returns normally, nothing
+    raises) must charge the slot's health exactly like an injected
+    fault — repeated hits quarantine the slot and live-migrate the
+    session to a healthy lane, instead of health recording ok while the
+    session's stream is frozen forever."""
+    encs = []
+    coord = make_sfe_coord(n_shards=2, max_lanes=2, encs=encs)
+    try:
+        f = coord.acquire(3840, 2160)
+        lane0 = f.lane_id
+        encs[0].fail_sessions.add(f.slot)    # the sick shard chip
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and coord.migrations_total < 1:
+            f.try_submit(b"frame")
+            f.poll()
+            time.sleep(0.005)
+        st = coord.stats()
+        assert st["migrations_total"] == 1
+        assert st["quarantined_total"] == 1
+        assert f.lane_id != lane0
+        # on the healthy lane the session streams full AUs again
+        # (withheld/empty results harvested around the migration may
+        # still drain first — wait for real content)
+        got = []
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not got:
+            f.try_submit(b"frame")
+            got += [r for r in f.poll() if r[1]]
+            time.sleep(0.005)
+        assert got and len(got[-1][1]) == 2
+        assert coord.verify_slot_accounting() == []
+    finally:
+        coord.stop()
+
+
+def test_sfe_harvest_trace_splits_fetch_and_pack():
+    """The coordinator folds the encoder's last_harvest_stages split
+    into the frame trace: fetch_wait (per-shard D2H) and pack (host
+    slice concat) both present, and stats surfaces the concat p50."""
+    coord = make_sfe_coord(n_shards=2, max_lanes=1)
+    try:
+        f = coord.acquire(3840, 2160)
+        tr = None
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and tr is None:
+            f.try_submit(b"frame")
+            for seq, _stripes in f.poll():
+                tr = f.pop_trace(seq)
+            time.sleep(0.005)
+        assert tr is not None
+        assert "dispatch" in tr and "fetch_wait" in tr and "pack" in tr
+        fw0, fw1 = tr["fetch_wait"]
+        pk0, pk1 = tr["pack"]
+        assert fw1 == pk0 and fw0 <= fw1 <= pk1  # contiguous split
+        st = coord.stats()
+        assert st["sfe_shards"] == 2
+        assert st["sfe_concat_ms_p50"] > 0.0
+        assert st["sfe_fetch_ms_p50"] > 0.0
+    finally:
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
 # serving plane: scheduler-driven admission through the real ws_handler
 
 

@@ -60,6 +60,18 @@ MESH_FAULT_MENU = (
     ("mesh.slot_raise", 3, None),
 )
 
+#: SFE storm kinds (ISSUE 15): drawn with --sfe, where the session rides
+#: a stripe-sharded lane spanning 2 virtual chips. The shard-targeted
+#: ``mesh.slot_raise=shard:K`` arms hit ONE stripe shard of the frame;
+#: the coordinator must degrade the whole session's tick (whole-frame
+#: containment — cohabitants unaffected, never a torn access unit) and
+#: walk the slot into quarantine + migration on repeats.
+SFE_FAULT_MENU = (
+    ("mesh.tick_raise", 1, None),
+    ("mesh.slot_raise", 3, "shard:0"),
+    ("mesh.slot_raise", 3, "shard:1"),
+)
+
 #: edge fault kinds (ISSUE 3): injected from the CLIENT side — a message
 #: flood / garbage burst through the websocket, exercising the rate
 #: limiter and per-message exception boundary rather than a server-side
@@ -106,7 +118,8 @@ def _inject_client_fault(ws, point: str, rng) -> None:
 
 async def chaos_session(duration_s: float = 10.0, seed: int = 0,
                         width: int = 160, height: int = 128,
-                        fps: float = 30.0, mesh: bool = False) -> dict:
+                        fps: float = 30.0, mesh: bool = False,
+                        sfe: bool = False) -> dict:
     """Run one chaos session; returns the survival report."""
     import tempfile
 
@@ -143,7 +156,15 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
         "SELKIES_LADDER_FAIL_THRESHOLD": "3",
         "SELKIES_LADDER_PROBE_MS": "2000",
     }
-    if mesh:
+    if sfe:
+        # the session rides a split-frame-encoding lane: its frame's
+        # stripe bands shard across 2 (virtual) chips, so shard-targeted
+        # mesh.slot_raise arms have a live call site (docs/scaling.md).
+        # sfe_min_pixels=1 makes even the tiny chaos geometry SFE.
+        env["SELKIES_TPU_MESH"] = "session:2"
+        env["SELKIES_SFE_MIN_PIXELS"] = "1"
+        env["SELKIES_TPU_SESSIONS_PER_CHIP"] = "1"
+    elif mesh:
         # the session rides the mesh scheduler instead of a solo encoder,
         # so the mesh.tick_raise / mesh.slot_raise kinds have a live
         # call site (docs/scaling.md)
@@ -224,7 +245,9 @@ async def chaos_session(duration_s: float = 10.0, seed: int = 0,
                 await reap(ws, task)
                 ws, task = await connect()
                 reconnects += 1
-            menu = FAULT_MENU + (MESH_FAULT_MENU if mesh else ())
+            menu = FAULT_MENU + (
+                SFE_FAULT_MENU if sfe
+                else MESH_FAULT_MENU if mesh else ())
             point, times, arg = menu[rng.randrange(len(menu))]
             if point == "session.churn":
                 await _churn_burst(server, rng)
@@ -322,14 +345,26 @@ def main(argv=None) -> int:
     p.add_argument("--mesh", action="store_true",
                    help="run the session through the mesh scheduler and "
                         "draw mesh.tick_raise / mesh.slot_raise kinds")
+    p.add_argument("--sfe", action="store_true",
+                   help="run the session on a 2-shard split-frame-"
+                        "encoding lane and draw shard-targeted "
+                        "mesh.slot_raise kinds (whole-frame containment "
+                        "storm, docs/scaling.md)")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
+    if args.sfe and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # the SFE lane needs 2 chips; fork virtual CPU devices BEFORE
+        # jax initializes (chaos imports jax lazily inside the session)
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device"
+                                     "_count=2").strip()
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.ERROR)
     report = asyncio.run(chaos_session(
         duration_s=args.duration, seed=args.seed,
         width=args.width, height=args.height, fps=args.fps,
-        mesh=args.mesh))
+        mesh=args.mesh, sfe=args.sfe))
     print(json.dumps(report, indent=2))
     return 0 if report["alive"] else 1
 

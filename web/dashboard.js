@@ -333,6 +333,14 @@ class SelkiesDashboard {
         const parts = Object.entries(s.mesh).map(([bucket, m]) => {
           let line = bucket + " " + m.active_sessions + "/" +
             m.capacity_slots + " (" + m.lanes + " lanes)";
+          if (m.sfe_shards > 1) {
+            // split-frame encoding: one frame sharded across N chips,
+            // with the host-side slice-concat share of the harvest
+            line += " sfe" + m.sfe_shards;
+            if (m.sfe_concat_ms_p50) {
+              line += " cat" + m.sfe_concat_ms_p50.toFixed(1);
+            }
+          }
           if (m.quarantined_slots) {
             line += " q" + m.quarantined_slots;
           }
